@@ -1,0 +1,11 @@
+"""babble-tpu: a TPU-native BFT consensus framework.
+
+A from-scratch rebuild of the capabilities of Babble (hashgraph consensus
+middleware, reference: /root/reference) designed TPU-first: the host runtime
+(gossip, DAG storage, blockchain projection, app proxy) is asyncio Python,
+and the virtual-voting consensus core is expressed as dense batched array
+kernels executed via JAX/XLA, swappable with a scalar CPU engine behind the
+same `Hashgraph` API (reference: src/hashgraph/hashgraph.go).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,829 @@
+"""Hashgraph consensus core — scalar (CPU) engine.
+
+Implements gossip-about-gossip virtual voting (reference:
+src/hashgraph/hashgraph.go): a DAG of events plus five consensus passes
+(DivideRounds, DecideFame, DecideRoundReceived, ProcessDecidedRounds,
+ProcessSigPool) projecting a total order of transactions onto a blockchain.
+
+This scalar engine is the semantic oracle: the TPU engine
+(babble_tpu.engine.tpu) must produce identical rounds / fame / consensus
+order on every DAG, enforced by differential tests.
+
+Design deltas from the reference (deliberate, TPU-first):
+- dense coordinates: last_ancestors / first_descendants are lists indexed by
+  peer *position* in the sorted validator set (the reference uses ordered
+  (participantId, coords) pairs, reference: src/hashgraph/event.go:62-99);
+  position indexing is what the device grids use, so both engines share it.
+- deterministic iteration everywhere (Python dicts are insertion-ordered;
+  the reference relies on order-independence of random Go map iteration).
+- memoization in plain dicts cleared on Reset (the reference uses bounded
+  LRUs, reference: src/hashgraph/hashgraph.go:36-40); recursions are
+  unrolled into explicit stacks so deep self-parent chains cannot overflow.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import StoreErr, StoreErrType, is_store_err
+from ..peers import Peers
+from .block import Block, BlockSignature, new_block_from_frame
+from .event import Event, WireEvent, root_self_parent
+from .frame import Frame
+from .root import Root, RootEvent
+from .round_info import PendingRound, RoundInfo
+from .store import Store
+
+MAX_INT32 = 2**31 - 1
+MIN_INT32 = -(2**31)
+
+
+def middle_bit(ehex: str) -> bool:
+    """Coin-round bit: middle byte of the event hash (reference:
+    src/hashgraph/hashgraph.go:1526-1535)."""
+    raw = bytes.fromhex(ehex[2:])
+    if len(raw) > 0 and raw[len(raw) // 2] == 0:
+        return False
+    return True
+
+
+class Hashgraph:
+    def __init__(
+        self,
+        participants: Peers,
+        store: Store,
+        commit_callback: Optional[Callable[[Block], None]] = None,
+        logger=None,
+    ):
+        import logging
+
+        n = len(participants)
+        self.participants = participants
+        self.store = store
+        self.commit_callback = commit_callback
+        self.super_majority = 2 * n // 3 + 1
+        self.trust_count = math.ceil(n / 3)
+        self.logger = logger or logging.getLogger("babble.hashgraph")
+
+        self.undetermined_events: List[str] = []
+        self.pending_rounds: List[PendingRound] = []
+        self.last_consensus_round: Optional[int] = None
+        self.first_consensus_round: Optional[int] = None
+        self.anchor_block: Optional[int] = None
+        self.last_committed_round_events = 0
+        self.sig_pool: List[BlockSignature] = []
+        self.consensus_transactions = 0
+        self.pending_loaded_events = 0
+        self.topological_index = 0
+
+        # peer-position lookups shared with the device grids
+        self._pos_by_pubkey: Dict[str, int] = {
+            p.pub_key_hex: i for i, p in enumerate(participants.to_peer_slice())
+        }
+        self._pos_by_id: Dict[int, int] = {
+            p.id: i for i, p in enumerate(participants.to_peer_slice())
+        }
+
+        # memo caches (unbounded dicts; cleared on Reset)
+        self._round_cache: Dict[str, int] = {}
+        self._timestamp_cache: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # positions
+    # ------------------------------------------------------------------
+
+    def peer_position(self, pub_key_hex: str) -> int:
+        return self._pos_by_pubkey[pub_key_hex]
+
+    # ------------------------------------------------------------------
+    # DAG predicates (reference: src/hashgraph/hashgraph.go:80-395)
+    # ------------------------------------------------------------------
+
+    def ancestor(self, x: str, y: str) -> bool:
+        """True if y is an ancestor of x (O(1) via last-ancestor coordinates)."""
+        if x == y:
+            return True
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        pos = self._pos_by_pubkey[ey.creator()]
+        last_known_index = ex.last_ancestors[pos][0]
+        return last_known_index >= ey.index()
+
+    def self_ancestor(self, x: str, y: str) -> bool:
+        if x == y:
+            return True
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        return ex.creator() == ey.creator() and ex.index() >= ey.index()
+
+    def see(self, x: str, y: str) -> bool:
+        # forks are prevented at insertion, so seeing == ancestry
+        return self.ancestor(x, y)
+
+    def strongly_see(self, x: str, y: str) -> bool:
+        """True if x sees y through events of a supermajority of validators:
+        count positions where x's last ancestor is at or past y's first
+        descendant (reference: src/hashgraph/hashgraph.go:172-191)."""
+        ex = self.store.get_event(x)
+        ey = self.store.get_event(y)
+        c = sum(
+            1
+            for la, fd in zip(ex.last_ancestors, ey.first_descendants)
+            if la[0] >= fd[0]
+        )
+        return c >= self.super_majority
+
+    # -- round ----------------------------------------------------------
+
+    def round(self, x: str) -> int:
+        cached = self._round_cache.get(x)
+        if cached is not None:
+            return cached
+        # iterative evaluation of the self/other-parent recursion
+        stack = [x]
+        while stack:
+            h = stack[-1]
+            if h in self._round_cache:
+                stack.pop()
+                continue
+            deps = self._round_deps(h)
+            missing = [d for d in deps if d not in self._round_cache]
+            if missing:
+                stack.extend(missing)
+                continue
+            self._round_cache[h] = self._round_once(h)
+            stack.pop()
+        return self._round_cache[x]
+
+    def _round_deps(self, x: str) -> List[str]:
+        """Parent hashes whose rounds must be known before x's."""
+        if x in self.store.roots_by_self_parent():
+            return []
+        ex = self.store.get_event(x)
+        root = self.store.get_root(ex.creator())
+        if ex.self_parent() == root.self_parent.hash:
+            other = root.others.get(ex.hex())
+            if ex.other_parent() == "" or (other is not None and other.hash == ex.other_parent()):
+                return []
+        deps = [ex.self_parent()]
+        if ex.other_parent() != "":
+            other = root.others.get(ex.hex())
+            if not (other is not None and other.hash == ex.other_parent()):
+                deps.append(ex.other_parent())
+        return deps
+
+    def _round_once(self, x: str) -> int:
+        """Single-step round computation assuming parent rounds are cached
+        (reference: src/hashgraph/hashgraph.go:205-278)."""
+        roots_by_sp = self.store.roots_by_self_parent()
+        if x in roots_by_sp:
+            return roots_by_sp[x].self_parent.round
+
+        ex = self.store.get_event(x)
+        root = self.store.get_root(ex.creator())
+
+        # event directly attached to the root
+        if ex.self_parent() == root.self_parent.hash:
+            other = root.others.get(ex.hex())
+            if ex.other_parent() == "" or (other is not None and other.hash == ex.other_parent()):
+                return root.next_round
+
+        # whitepaper formula: parent round + increment
+        parent_round = self._round_cache[ex.self_parent()]
+        if ex.other_parent() != "":
+            other = root.others.get(ex.hex())
+            if other is not None and other.hash == ex.other_parent():
+                op_round = root.next_round
+            else:
+                op_round = self._round_cache[ex.other_parent()]
+            if op_round > parent_round:
+                parent_round = op_round
+
+        c = 0
+        for w in self.store.round_witnesses(parent_round):
+            if self.strongly_see(x, w):
+                c += 1
+        if c >= self.super_majority:
+            parent_round += 1
+        return parent_round
+
+    def witness(self, x: str) -> bool:
+        """True if x is the first event of its creator in its round."""
+        ex = self.store.get_event(x)
+        return self.round(x) > self.round(ex.self_parent())
+
+    def round_received(self, x: str) -> int:
+        ex = self.store.get_event(x)
+        return ex.round_received if ex.round_received is not None else -1
+
+    # -- lamport ---------------------------------------------------------
+
+    def lamport_timestamp(self, x: str) -> int:
+        cached = self._timestamp_cache.get(x)
+        if cached is not None:
+            return cached
+        stack = [x]
+        while stack:
+            h = stack[-1]
+            if h in self._timestamp_cache:
+                stack.pop()
+                continue
+            deps = self._lamport_deps(h)
+            missing = [d for d in deps if d not in self._timestamp_cache]
+            if missing:
+                stack.extend(missing)
+                continue
+            self._timestamp_cache[h] = self._lamport_once(h)
+            stack.pop()
+        return self._timestamp_cache[x]
+
+    def _lamport_deps(self, x: str) -> List[str]:
+        if x in self.store.roots_by_self_parent():
+            return []
+        ex = self.store.get_event(x)
+        root = self.store.get_root(ex.creator())
+        deps = []
+        if ex.self_parent() != root.self_parent.hash:
+            deps.append(ex.self_parent())
+        if ex.other_parent() != "":
+            try:
+                self.store.get_event(ex.other_parent())
+                deps.append(ex.other_parent())
+            except StoreErr:
+                pass
+        return deps
+
+    def _lamport_once(self, x: str) -> int:
+        """reference: src/hashgraph/hashgraph.go:325-379."""
+        roots_by_sp = self.store.roots_by_self_parent()
+        if x in roots_by_sp:
+            return roots_by_sp[x].self_parent.lamport_timestamp
+
+        ex = self.store.get_event(x)
+        root = self.store.get_root(ex.creator())
+
+        if ex.self_parent() == root.self_parent.hash:
+            plt = root.self_parent.lamport_timestamp
+        else:
+            plt = self._timestamp_cache[ex.self_parent()]
+
+        if ex.other_parent() != "":
+            op_lt = MIN_INT32
+            if ex.other_parent() in self._timestamp_cache:
+                op_lt = self._timestamp_cache[ex.other_parent()]
+            else:
+                other = root.others.get(x)
+                if other is not None and other.hash == ex.other_parent():
+                    op_lt = other.lamport_timestamp
+            if op_lt > plt:
+                plt = op_lt
+
+        return plt + 1
+
+    def round_diff(self, x: str, y: str) -> int:
+        return self.round(x) - self.round(y)
+
+    # ------------------------------------------------------------------
+    # insertion (reference: src/hashgraph/hashgraph.go:398-544,714-761)
+    # ------------------------------------------------------------------
+
+    def _check_self_parent(self, event: Event) -> None:
+        creator_last_known, _ = self.store.last_event_from(event.creator())
+        if event.self_parent() != creator_last_known:
+            raise ValueError("Self-parent not last known event by creator")
+
+    def _check_other_parent(self, event: Event) -> None:
+        other_parent = event.other_parent()
+        if other_parent == "":
+            return
+        try:
+            self.store.get_event(other_parent)
+            return
+        except StoreErr:
+            root = self.store.get_root(event.creator())
+            other = root.others.get(event.hex())
+            if other is not None and other.hash == other_parent:
+                return
+            raise ValueError("Other-parent not known")
+
+    def _init_event_coordinates(self, event: Event) -> None:
+        n = len(self.participants)
+        event.first_descendants = [(MAX_INT32, "")] * n
+
+        sp: Optional[Event] = None
+        op: Optional[Event] = None
+        try:
+            sp = self.store.get_event(event.self_parent())
+        except StoreErr:
+            pass
+        try:
+            op = self.store.get_event(event.other_parent())
+        except StoreErr:
+            pass
+
+        if sp is None and op is None:
+            event.last_ancestors = [(-1, "")] * n
+        elif sp is None:
+            event.last_ancestors = list(op.last_ancestors)
+        elif op is None:
+            event.last_ancestors = list(sp.last_ancestors)
+        else:
+            event.last_ancestors = [
+                a if a[0] >= b[0] else b
+                for a, b in zip(sp.last_ancestors, op.last_ancestors)
+            ]
+
+        pos = self._pos_by_pubkey[event.creator()]
+        coords = (event.index(), event.hex())
+        event.first_descendants[pos] = coords
+        event.last_ancestors[pos] = coords
+
+    def _update_ancestor_first_descendant(self, event: Event) -> None:
+        """Walk each last-ancestor's self-parent chain marking this event as
+        first descendant (reference: src/hashgraph/hashgraph.go:510-544)."""
+        pos = self._pos_by_pubkey[event.creator()]
+        coords = (event.index(), event.hex())
+        for _, ah in event.last_ancestors:
+            while ah != "":
+                try:
+                    a = self.store.get_event(ah)
+                except StoreErr:
+                    break
+                if a.first_descendants[pos][0] == MAX_INT32:
+                    a.first_descendants[pos] = coords
+                    self.store.set_event(a)
+                    ah = a.self_parent()
+                else:
+                    break
+
+    def insert_event(self, event: Event, set_wire_info: bool) -> None:
+        if not event.verify():
+            raise ValueError("Invalid Event signature")
+
+        self._check_self_parent(event)
+        self._check_other_parent(event)
+
+        event.topological_index = self.topological_index
+        self.topological_index += 1
+
+        if set_wire_info:
+            self._set_wire_info(event)
+
+        self._init_event_coordinates(event)
+        self.store.set_event(event)
+        self._update_ancestor_first_descendant(event)
+
+        self.undetermined_events.append(event.hex())
+        if event.is_loaded():
+            self.pending_loaded_events += 1
+        self.sig_pool.extend(event.block_signatures())
+
+    def _set_wire_info(self, event: Event) -> None:
+        self_parent_index = -1
+        other_parent_creator_id = -1
+        other_parent_index = -1
+
+        last_from, is_root = self.store.last_event_from(event.creator())
+        if is_root and last_from == event.self_parent():
+            root = self.store.get_root(event.creator())
+            self_parent_index = root.self_parent.index
+        else:
+            self_parent = self.store.get_event(event.self_parent())
+            self_parent_index = self_parent.index()
+
+        if event.other_parent() != "":
+            root = self.store.get_root(event.creator())
+            other = root.others.get(event.hex())
+            if other is not None and other.hash == event.other_parent():
+                other_parent_creator_id = other.creator_id
+                other_parent_index = other.index
+            else:
+                other_parent = self.store.get_event(event.other_parent())
+                other_parent_creator_id = self.participants.by_pub_key[
+                    other_parent.creator()
+                ].id
+                other_parent_index = other_parent.index()
+
+        event.set_wire_info(
+            self_parent_index,
+            other_parent_creator_id,
+            other_parent_index,
+            self.participants.by_pub_key[event.creator()].id,
+        )
+
+    # ------------------------------------------------------------------
+    # roots (reference: src/hashgraph/hashgraph.go:546-640)
+    # ------------------------------------------------------------------
+
+    def _create_self_parent_root_event(self, ev: Event) -> RootEvent:
+        sp = ev.self_parent()
+        return RootEvent(
+            hash=sp,
+            creator_id=self.participants.by_pub_key[ev.creator()].id,
+            index=ev.index() - 1,
+            lamport_timestamp=self.lamport_timestamp(sp),
+            round=self.round(sp),
+        )
+
+    def _create_other_parent_root_event(self, ev: Event) -> RootEvent:
+        op = ev.other_parent()
+        root = self.store.get_root(ev.creator())
+        other = root.others.get(ev.hex())
+        if other is not None and other.hash == op:
+            return other
+        other_parent = self.store.get_event(op)
+        return RootEvent(
+            hash=op,
+            creator_id=self.participants.by_pub_key[other_parent.creator()].id,
+            index=other_parent.index(),
+            lamport_timestamp=self.lamport_timestamp(op),
+            round=self.round(op),
+        )
+
+    def _create_root(self, ev: Event) -> Root:
+        root = Root(
+            next_round=self.round(ev.hex()),
+            self_parent=self._create_self_parent_root_event(ev),
+            others={},
+        )
+        if ev.other_parent() != "":
+            root.others[ev.hex()] = self._create_other_parent_root_event(ev)
+        return root
+
+    # ------------------------------------------------------------------
+    # the five passes
+    # ------------------------------------------------------------------
+
+    def divide_rounds(self) -> None:
+        """Assign round + lamport timestamp, flag witnesses, queue pending
+        rounds (reference: src/hashgraph/hashgraph.go:767-849)."""
+        for hash_ in self.undetermined_events:
+            ev = self.store.get_event(hash_)
+            update_event = False
+
+            if ev.round is None:
+                round_number = self.round(hash_)
+                ev.set_round(round_number)
+                update_event = True
+
+                try:
+                    round_info = self.store.get_round(round_number)
+                except StoreErr as e:
+                    if not is_store_err(e, StoreErrType.KEY_NOT_FOUND):
+                        raise
+                    round_info = RoundInfo()
+
+                # lower bound prevents reprocessing the base layer after Reset
+                if not round_info.queued and (
+                    self.last_consensus_round is None
+                    or round_number >= self.last_consensus_round
+                ):
+                    self.pending_rounds.append(PendingRound(round_number, False))
+                    round_info.queued = True
+
+                round_info.add_event(hash_, self.witness(hash_))
+                self.store.set_round(round_number, round_info)
+
+            if ev.lamport_timestamp is None:
+                ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
+                update_event = True
+
+            if update_event:
+                self.store.set_event(ev)
+
+    def decide_fame(self) -> None:
+        """Virtual voting on witness fame (reference:
+        src/hashgraph/hashgraph.go:852-947)."""
+        votes: Dict[Tuple[str, str], bool] = {}  # (y, x) => vote
+
+        decided_rounds: Dict[int, int] = {}
+
+        for pos, pr in enumerate(self.pending_rounds):
+            round_index = pr.index
+            round_info = self.store.get_round(round_index)
+            for x in round_info.witnesses():
+                if round_info.is_decided(x):
+                    continue
+                decided = False
+                for j in range(round_index + 1, self.store.last_round() + 1):
+                    if decided:
+                        break
+                    for y in self.store.round_witnesses(j):
+                        diff = j - round_index
+                        if diff == 1:
+                            votes[(y, x)] = self.see(y, x)
+                        else:
+                            # count votes among strongly-seen prev-round witnesses
+                            ss_witnesses = [
+                                w
+                                for w in self.store.round_witnesses(j - 1)
+                                if self.strongly_see(y, w)
+                            ]
+                            yays = sum(1 for w in ss_witnesses if votes.get((w, x), False))
+                            nays = len(ss_witnesses) - yays
+                            v = yays >= nays
+                            t = yays if v else nays
+
+                            if diff % len(self.participants) > 0:
+                                # normal round: supermajority decides
+                                if t >= self.super_majority:
+                                    round_info.set_fame(x, v)
+                                    votes[(y, x)] = v
+                                    decided = True
+                                    break
+                                votes[(y, x)] = v
+                            else:
+                                # coin round
+                                if t >= self.super_majority:
+                                    votes[(y, x)] = v
+                                else:
+                                    votes[(y, x)] = middle_bit(y)
+
+            self.store.set_round(round_index, round_info)
+            if round_info.witnesses_decided():
+                decided_rounds[round_index] = pos
+
+        for pr in self.pending_rounds:
+            if pr.index in decided_rounds:
+                pr.decided = True
+
+    def decide_round_received(self) -> None:
+        """An event is received in the first round where all unique famous
+        witnesses see it, provided all earlier rounds are fully decided
+        (reference: src/hashgraph/hashgraph.go:951-1036)."""
+        new_undetermined: List[str] = []
+
+        for x in self.undetermined_events:
+            received = False
+            r = self.round(x)
+
+            for i in range(r + 1, self.store.last_round() + 1):
+                try:
+                    tr = self.store.get_round(i)
+                except StoreErr:
+                    # can happen after Reset/fast-sync
+                    if (
+                        self.last_consensus_round is not None
+                        and r < self.last_consensus_round
+                    ):
+                        received = True
+                        break
+                    raise
+
+                if not tr.witnesses_decided():
+                    break
+
+                fws = tr.famous_witnesses()
+                s = [w for w in fws if self.see(w, x)]
+
+                if len(s) == len(fws) and len(s) > 0:
+                    received = True
+                    ex = self.store.get_event(x)
+                    ex.set_round_received(i)
+                    self.store.set_event(ex)
+                    tr.set_consensus_event(x)
+                    self.store.set_round(i, tr)
+                    break
+
+            if not received:
+                new_undetermined.append(x)
+
+        self.undetermined_events = new_undetermined
+
+    def process_decided_rounds(self) -> None:
+        """Map decided rounds onto Frames and Blocks; commit through the
+        callback (reference: src/hashgraph/hashgraph.go:1041-1122)."""
+        processed_index = 0
+        try:
+            for pr in self.pending_rounds:
+                # never process a decided round before all previous rounds
+                if not pr.decided:
+                    break
+
+                # skip the base round after a Reset
+                if (
+                    self.last_consensus_round is not None
+                    and pr.index == self.last_consensus_round
+                ):
+                    processed_index += 1
+                    continue
+
+                frame = self.get_frame(pr.index)
+
+                if frame.events:
+                    for e in frame.events:
+                        self.store.add_consensus_event(e)
+                        self.consensus_transactions += len(e.transactions())
+                        if e.is_loaded():
+                            self.pending_loaded_events -= 1
+
+                    last_block_index = self.store.last_block_index()
+                    block = new_block_from_frame(last_block_index + 1, frame)
+                    self.store.set_block(block)
+                    if self.commit_callback is not None:
+                        self.commit_callback(block)
+
+                processed_index += 1
+
+                if self.last_consensus_round is None or pr.index > self.last_consensus_round:
+                    self._set_last_consensus_round(pr.index)
+        finally:
+            self.pending_rounds = self.pending_rounds[processed_index:]
+
+    def get_frame(self, round_received: int) -> Frame:
+        """reference: src/hashgraph/hashgraph.go:1125-1231."""
+        try:
+            return self.store.get_frame(round_received)
+        except StoreErr as e:
+            if not is_store_err(e, StoreErrType.KEY_NOT_FOUND):
+                raise
+
+        round_info = self.store.get_round(round_received)
+        events = [self.store.get_event(eh) for eh in round_info.consensus_events()]
+        from .event import by_lamport_key
+
+        events.sort(key=by_lamport_key)
+
+        roots: Dict[str, Root] = {}
+        for ev in events:
+            p = ev.creator()
+            if p not in roots:
+                roots[p] = self._create_root(ev)
+
+        # participants with no events in the frame: root from last consensus event
+        for p in self.participants.to_pub_key_slice():
+            if p not in roots:
+                last_consensus, is_root = self.store.last_consensus_event_from(p)
+                if is_root:
+                    root = self.store.get_root(p)
+                else:
+                    root = self._create_root(self.store.get_event(last_consensus))
+                roots[p] = root
+
+        # other-parents outside the frame must be reachable via Root.Others
+        treated = set()
+        for ev in events:
+            treated.add(ev.hex())
+            other_parent = ev.other_parent()
+            if other_parent != "" and other_parent not in treated:
+                if ev.self_parent() != roots[ev.creator()].self_parent.hash:
+                    roots[ev.creator()].others[ev.hex()] = (
+                        self._create_other_parent_root_event(ev)
+                    )
+
+        ordered_roots = [roots[p.pub_key_hex] for p in self.participants.to_peer_slice()]
+
+        res = Frame(round=round_received, roots=ordered_roots, events=events)
+        self.store.set_frame(res)
+        return res
+
+    def process_sig_pool(self) -> None:
+        """Attach valid signatures to blocks; advance the anchor block once a
+        block has >1/3 signatures (reference: src/hashgraph/hashgraph.go:1236-1300)."""
+        processed = set()
+        try:
+            for i, bs in enumerate(self.sig_pool):
+                validator_hex = bs.validator_hex()
+                if validator_hex not in self.participants.by_pub_key:
+                    self.logger.warning(
+                        "Unknown validator for block signature: %s", validator_hex
+                    )
+                    continue
+                try:
+                    block = self.store.get_block(bs.index)
+                except StoreErr:
+                    continue
+                if not block.verify(bs):
+                    self.logger.warning("Invalid block signature for block %d", bs.index)
+                    continue
+
+                block.set_signature(bs)
+                self.store.set_block(block)
+
+                if len(block.signatures) > self.trust_count and (
+                    self.anchor_block is None or block.index() > self.anchor_block
+                ):
+                    self.anchor_block = block.index()
+
+                processed.add(i)
+        finally:
+            self.sig_pool = [bs for i, bs in enumerate(self.sig_pool) if i not in processed]
+
+    def run_consensus(self) -> None:
+        """The full pipeline (reference: src/node/core.go:335-377)."""
+        self.divide_rounds()
+        self.decide_fame()
+        self.decide_round_received()
+        self.process_decided_rounds()
+        self.process_sig_pool()
+
+    # ------------------------------------------------------------------
+    # anchor / reset / bootstrap (reference: src/hashgraph/hashgraph.go:1302-1410)
+    # ------------------------------------------------------------------
+
+    def get_anchor_block_with_frame(self) -> Tuple[Block, Frame]:
+        if self.anchor_block is None:
+            raise ValueError("No Anchor Block")
+        block = self.store.get_block(self.anchor_block)
+        frame = self.get_frame(block.round_received())
+        return block, frame
+
+    def reset(self, block: Block, frame: Frame) -> None:
+        self.last_consensus_round = None
+        self.first_consensus_round = None
+        self.anchor_block = None
+
+        self.undetermined_events = []
+        self.pending_rounds = []
+        self.pending_loaded_events = 0
+        self.topological_index = 0
+
+        self._round_cache.clear()
+        self._timestamp_cache.clear()
+
+        participants = self.participants.to_peer_slice()
+        root_map = {participants[pos].pub_key_hex: root for pos, root in enumerate(frame.roots)}
+        self.store.reset(root_map)
+        self.store.set_block(block)
+        self._set_last_consensus_round(block.round_received())
+
+        for ev in frame.events:
+            self.insert_event(ev, False)
+
+    def bootstrap(self) -> None:
+        """Replay a persistent store's topologically-ordered events through
+        the full pipeline (reference: src/hashgraph/hashgraph.go:1375-1410)."""
+        topo = getattr(self.store, "db_topological_events", None)
+        if topo is None:
+            return
+        for e in topo():
+            self.insert_event(e, True)
+        self.run_consensus()
+
+    # ------------------------------------------------------------------
+    # wire (reference: src/hashgraph/hashgraph.go:1414-1479)
+    # ------------------------------------------------------------------
+
+    def read_wire_info(self, wevent: WireEvent) -> Event:
+        self_parent = root_self_parent(wevent.body.creator_id)
+        other_parent = ""
+
+        creator = self.participants.by_id[wevent.body.creator_id]
+        creator_bytes = bytes.fromhex(creator.pub_key_hex[2:])
+
+        if wevent.body.self_parent_index >= 0:
+            self_parent = self.store.participant_event(
+                creator.pub_key_hex, wevent.body.self_parent_index
+            )
+        if wevent.body.other_parent_index >= 0:
+            try:
+                other_creator = self.participants.by_id[wevent.body.other_parent_creator_id]
+                other_parent = self.store.participant_event(
+                    other_creator.pub_key_hex, wevent.body.other_parent_index
+                )
+            except (StoreErr, KeyError):
+                # check if other parent can be found in the creator's root
+                root = self.store.get_root(creator.pub_key_hex)
+                found = False
+                for re_ in root.others.values():
+                    if (
+                        re_.creator_id == wevent.body.other_parent_creator_id
+                        and re_.index == wevent.body.other_parent_index
+                    ):
+                        other_parent = re_.hash
+                        found = True
+                        break
+                if not found:
+                    raise ValueError("OtherParent not found")
+
+        event = Event(
+            transactions=wevent.body.transactions,
+            block_signatures=wevent.block_signatures(creator_bytes),
+            parents=[self_parent, other_parent],
+            creator=creator_bytes,
+            index=wevent.body.index,
+        )
+        event.signature = wevent.signature
+        event.set_wire_info(
+            wevent.body.self_parent_index,
+            wevent.body.other_parent_creator_id,
+            wevent.body.other_parent_index,
+            wevent.body.creator_id,
+        )
+        return event
+
+    def check_block(self, block: Block) -> None:
+        """Valid iff strictly more than 1/3 of participants signed."""
+        valid = sum(1 for s in block.get_signatures() if block.verify(s))
+        if valid <= self.trust_count:
+            raise ValueError(
+                f"Not enough valid signatures: got {valid}, need {self.trust_count + 1}"
+            )
+
+    # ------------------------------------------------------------------
+
+    def _set_last_consensus_round(self, i: int) -> None:
+        self.last_consensus_round = i
+        if self.first_consensus_round is None:
+            self.first_consensus_round = i

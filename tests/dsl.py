@@ -1,0 +1,207 @@
+"""The `play` DSL: scripted DAG construction for consensus tests.
+
+Port of the reference's load-bearing test harness (reference:
+src/hashgraph/hashgraph_test.go:69-157): events are described as
+{to, index, selfParent, otherParent, name, txPayload, sigPayload} tuples
+against a name->hash index, then inserted into a fresh hashgraph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from babble_tpu import crypto
+from babble_tpu.common import hash32
+from babble_tpu.hashgraph import (
+    BlockSignature,
+    Event,
+    Hashgraph,
+    InmemStore,
+    root_self_parent,
+)
+from babble_tpu.peers import Peer, Peers
+
+CACHE_SIZE = 100
+
+
+@dataclass
+class Play:
+    to: int
+    index: int
+    self_parent: str
+    other_parent: str
+    name: str
+    tx_payload: Optional[List[bytes]] = None
+    sig_payload: Optional[List[BlockSignature]] = None
+
+
+class TestNode:
+    def __init__(self, key):
+        self.key = key
+        self.pub = crypto.pub_key_bytes(key)
+        self.id = hash32(self.pub)
+        self.pub_hex = "0x" + self.pub.hex().upper()
+        self.events: List[Event] = []
+
+    def sign_and_add_event(self, event: Event, name: str, index: Dict[str, str], ordered):
+        event.sign(self.key)
+        self.events.append(event)
+        index[name] = event.hex()
+        ordered.append(event)
+
+
+def init_hashgraph_nodes(n: int) -> Tuple[List[TestNode], Dict[str, str], List[Event], Peers]:
+    index: Dict[str, str] = {}
+    ordered: List[Event] = []
+    keys = {}
+    participants = Peers()
+    for _ in range(n):
+        key = crypto.generate_key()
+        pub_hex = "0x" + crypto.pub_key_bytes(key).hex().upper()
+        participants.add_peer(Peer(pub_key_hex=pub_hex, net_addr=""))
+        keys[pub_hex] = key
+
+    nodes = [TestNode(keys[p.pub_key_hex]) for p in participants.to_peer_slice()]
+    return nodes, index, ordered, participants
+
+
+def play_events(plays: List[Play], nodes, index, ordered) -> None:
+    for p in plays:
+        e = Event(
+            transactions=p.tx_payload,
+            block_signatures=p.sig_payload,
+            parents=[index.get(p.self_parent, ""), index.get(p.other_parent, "")],
+            creator=nodes[p.to].pub,
+            index=p.index,
+        )
+        nodes[p.to].sign_and_add_event(e, p.name, index, ordered)
+
+
+def create_hashgraph(ordered, participants, store=None) -> Hashgraph:
+    store = store or InmemStore(participants, CACHE_SIZE)
+    h = Hashgraph(participants, store)
+    for ev in ordered:
+        h.insert_event(ev, True)
+    return h
+
+
+def init_hashgraph_full(plays: List[Play], n: int, store_factory=None):
+    nodes, index, ordered, participants = init_hashgraph_nodes(n)
+
+    # first events attach to each sorted peer's root
+    for i, peer in enumerate(participants.to_peer_slice()):
+        ev = Event(parents=[root_self_parent(peer.id), ""], creator=nodes[i].pub, index=0)
+        nodes[i].sign_and_add_event(ev, f"e{i}", index, ordered)
+
+    play_events(plays, nodes, index, ordered)
+
+    store = store_factory(participants) if store_factory else None
+    h = create_hashgraph(ordered, participants, store)
+    return h, index, ordered
+
+
+# ---------------------------------------------------------------------------
+# named topologies (reference: src/hashgraph/hashgraph_test.go)
+# ---------------------------------------------------------------------------
+
+def init_simple_hashgraph(store_factory=None):
+    """reference: hashgraph_test.go:161-201.
+
+    |  e12  |
+    |   | \\ |
+    |  s10 e20
+    |   | / |
+    |   /   |
+    | / |   |
+    s00 |  s20
+    |   |   |
+    e01 |   |
+    | \\ |   |
+    e0  e1  e2
+    0   1   2
+    """
+    plays = [
+        Play(0, 1, "e0", "e1", "e01"),
+        Play(2, 1, "e2", "", "s20"),
+        Play(1, 1, "e1", "", "s10"),
+        Play(0, 2, "e01", "", "s00"),
+        Play(2, 2, "s20", "s00", "e20"),
+        Play(1, 2, "s10", "e20", "e12"),
+    ]
+    return init_hashgraph_full(plays, 3, store_factory)
+
+
+def init_round_hashgraph(store_factory=None):
+    """reference: hashgraph_test.go:400-434.
+
+    |  s11  |
+    |   |   |
+    |   f1  |
+    |  /|   |
+    | / s10 |
+    |/  |   |
+    e02 |   |
+    | \\ |   |
+    |   \\   |
+    |   | \\ |
+    s00 |  e21
+    |   | / |
+    |  e10  s20
+    | / |   |
+    e0  e1  e2
+    """
+    plays = [
+        Play(1, 1, "e1", "e0", "e10"),
+        Play(2, 1, "e2", "", "s20"),
+        Play(0, 1, "e0", "", "s00"),
+        Play(2, 2, "s20", "e10", "e21"),
+        Play(0, 2, "s00", "e21", "e02"),
+        Play(1, 2, "e10", "", "s10"),
+        Play(1, 3, "s10", "e02", "f1"),
+        Play(1, 4, "f1", "", "s11", [b"abc"]),
+    ]
+    return init_hashgraph_full(plays, 3, store_factory)
+
+
+def init_consensus_hashgraph(store_factory=None):
+    """reference: hashgraph_test.go:1170-1205 — runs to round 4, decides
+    rounds 0-2, commits 2 blocks."""
+    plays = [
+        Play(1, 1, "e1", "e0", "e10"),
+        Play(2, 1, "e2", "e10", "e21", [b"e21"]),
+        Play(2, 2, "e21", "", "e21b"),
+        Play(0, 1, "e0", "e21b", "e02"),
+        Play(1, 2, "e10", "e02", "f1"),
+        Play(1, 3, "f1", "", "f1b", [b"f1b"]),
+        Play(0, 2, "e02", "f1b", "f0"),
+        Play(2, 3, "e21b", "f1b", "f2"),
+        Play(1, 4, "f1b", "f0", "f10"),
+        Play(0, 3, "f0", "e21", "f0x"),
+        Play(2, 4, "f2", "f10", "f21"),
+        Play(0, 4, "f0x", "f21", "f02"),
+        Play(0, 5, "f02", "", "f02b", [b"f02b"]),
+        Play(1, 5, "f10", "f02b", "g1"),
+        Play(0, 6, "f02b", "g1", "g0"),
+        Play(2, 5, "f21", "g1", "g2"),
+        Play(1, 6, "g1", "g0", "g10", [b"g10"]),
+        Play(2, 6, "g2", "g10", "g21"),
+        Play(0, 7, "g0", "g21", "g02", [b"g02"]),
+        Play(1, 7, "g10", "g02", "h1"),
+        Play(0, 8, "g02", "h1", "h0"),
+        Play(2, 7, "g21", "h1", "h2"),
+        Play(1, 8, "h1", "h0", "h10"),
+        Play(2, 8, "h2", "h10", "h21"),
+        Play(0, 9, "h0", "h21", "h02"),
+        Play(1, 9, "h10", "h02", "i1"),
+        Play(0, 10, "h02", "i1", "i0"),
+        Play(2, 9, "h21", "i1", "i2"),
+    ]
+    return init_hashgraph_full(plays, 3, store_factory)
+
+
+def get_name(index: Dict[str, str], hash_: str) -> str:
+    for name, h in index.items():
+        if h == hash_:
+            return name
+    return f"unknown event {hash_}"

@@ -1,0 +1,125 @@
+"""Foundation tests (reference: src/common/lru_test.go, rolling_index_test.go)."""
+
+import pytest
+
+from babble_tpu.common import (
+    LRU,
+    RollingIndex,
+    RollingIndexMap,
+    StoreErr,
+    StoreErrType,
+    hash32,
+    is_store_err,
+)
+
+
+class TestLRU:
+    def test_add_get(self):
+        lru = LRU(2)
+        lru.add("a", 1)
+        lru.add("b", 2)
+        v, ok = lru.get("a")
+        assert ok and v == 1
+
+    def test_eviction(self):
+        evicted = []
+        lru = LRU(2, on_evict=lambda k, v: evicted.append(k))
+        lru.add("a", 1)
+        lru.add("b", 2)
+        lru.add("c", 3)  # evicts a
+        _, ok = lru.get("a")
+        assert not ok
+        assert evicted == ["a"]
+
+    def test_recency(self):
+        lru = LRU(2)
+        lru.add("a", 1)
+        lru.add("b", 2)
+        lru.get("a")  # refresh a
+        lru.add("c", 3)  # evicts b
+        _, ok = lru.get("b")
+        assert not ok
+        _, ok = lru.get("a")
+        assert ok
+
+    def test_keys_order(self):
+        lru = LRU(3)
+        for k in "abc":
+            lru.add(k, k)
+        assert lru.keys() == ["a", "b", "c"]
+
+
+class TestRollingIndex:
+    def test_sequential_set_get(self):
+        ri = RollingIndex("test", 10)
+        items = [f"item{i}" for i in range(9)]
+        for i, it in enumerate(items):
+            ri.set(it, i)
+        cached, last = ri.get_last_window()
+        assert last == 8
+        assert list(cached) == items
+        assert ri.get(4) == items[5:]
+
+    def test_skipped_index(self):
+        ri = RollingIndex("test", 10)
+        ri.set("item0", 0)
+        with pytest.raises(StoreErr) as ei:
+            ri.set("item2", 2)
+        assert is_store_err(ei.value, StoreErrType.SKIPPED_INDEX)
+
+    def test_roll(self):
+        size = 10
+        ri = RollingIndex("test", size)
+        for i in range(2 * size + 1):  # one past the window: triggers roll
+            ri.set(f"item{i}", i)
+        cached, last = ri.get_last_window()
+        assert last == 2 * size
+        assert len(cached) == size + 1
+        assert cached[0] == f"item{size}"
+        # old items are TooLate
+        with pytest.raises(StoreErr) as ei:
+            ri.get_item(size - 1)
+        assert is_store_err(ei.value, StoreErrType.TOO_LATE)
+        assert ri.get_item(size) == f"item{size}"
+
+    def test_get_item(self):
+        ri = RollingIndex("test", 10)
+        for i in range(5):
+            ri.set(i * 100, i)
+        assert ri.get_item(3) == 300
+        with pytest.raises(StoreErr) as ei:
+            ri.get_item(9)
+        assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+
+    def test_replace_existing(self):
+        ri = RollingIndex("test", 10)
+        for i in range(5):
+            ri.set(i, i)
+        ri.set(99, 3)
+        assert ri.get_item(3) == 99
+
+
+class TestRollingIndexMap:
+    def test_basic(self):
+        rim = RollingIndexMap("test", 5, [1, 2, 3])
+        rim.set(1, "a", 0)
+        rim.set(2, "b", 0)
+        assert rim.get_last(1) == "a"
+        known = rim.known()
+        assert known == {1: 0, 2: 0, 3: -1}
+        with pytest.raises(StoreErr) as ei:
+            rim.get_last(3)
+        assert is_store_err(ei.value, StoreErrType.EMPTY)
+
+    def test_reset(self):
+        rim = RollingIndexMap("test", 5, [1])
+        rim.set(1, "a", 0)
+        rim.reset()
+        assert rim.known() == {1: -1}
+
+
+def test_hash32_known_vectors():
+    # FNV-1a 32-bit reference vectors
+    assert hash32(b"") == 2166136261
+    assert hash32(b"a") == 0xE40C292C
+    assert hash32(b"foobar") == 0xBF9CF968

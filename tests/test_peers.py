@@ -1,0 +1,50 @@
+"""Peers tests (reference: src/peers/peer_test.go, json_peers_test.go)."""
+
+from babble_tpu import crypto
+from babble_tpu.common import hash32
+from babble_tpu.peers import JSONPeers, Peer, Peers, exclude_peer
+
+
+def _make_peer(addr="addr") -> Peer:
+    key = crypto.generate_key()
+    pub_hex = "0x" + crypto.pub_key_bytes(key).hex().upper()
+    return Peer(net_addr=addr, pub_key_hex=pub_hex)
+
+
+def test_peer_id_is_fnv_of_pubkey():
+    p = _make_peer()
+    assert p.id == hash32(p.pub_key_bytes())
+
+
+def test_peers_sorted_by_id():
+    ps = [_make_peer(f"addr{i}") for i in range(5)]
+    peers = Peers.from_slice(ps)
+    ids = peers.to_id_slice()
+    assert ids == sorted(ids)
+    assert len(peers) == 5
+
+
+def test_peers_add_remove():
+    peers = Peers.from_slice([_make_peer("a"), _make_peer("b")])
+    extra = _make_peer("c")
+    peers.add_peer(extra)
+    assert len(peers) == 3
+    peers.remove_peer_by_pub_key(extra.pub_key_hex)
+    assert len(peers) == 2
+    assert extra.pub_key_hex not in peers.by_pub_key
+
+
+def test_exclude_peer():
+    ps = [_make_peer("a"), _make_peer("b"), _make_peer("c")]
+    idx, rest = exclude_peer(ps, "b")
+    assert idx == 1
+    assert [p.net_addr for p in rest] == ["a", "c"]
+
+
+def test_json_peers_roundtrip(tmp_path):
+    store = JSONPeers(str(tmp_path))
+    ps = [_make_peer(f"addr{i}") for i in range(3)]
+    store.set_peers(ps)
+    loaded = store.peers()
+    assert len(loaded) == 3
+    assert set(loaded.by_pub_key.keys()) == {p.pub_key_hex for p in ps}
