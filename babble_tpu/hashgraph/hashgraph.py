@@ -711,12 +711,22 @@ class Hashgraph:
             self.sig_pool = [bs for i, bs in enumerate(self.sig_pool) if i not in processed]
 
     def run_consensus(self) -> None:
-        """The full pipeline (reference: src/node/core.go:335-377)."""
-        self.divide_rounds()
-        self.decide_fame()
-        self.decide_round_received()
-        self.process_decided_rounds()
-        self.process_sig_pool()
+        """The full pipeline with per-pass timing logs
+        (reference: src/node/core.go:335-377)."""
+        import time
+
+        for name, pass_ in (
+            ("DivideRounds", self.divide_rounds),
+            ("DecideFame", self.decide_fame),
+            ("DecideRoundReceived", self.decide_round_received),
+            ("ProcessDecidedRounds", self.process_decided_rounds),
+            ("ProcessSigPool", self.process_sig_pool),
+        ):
+            start = time.monotonic()
+            pass_()
+            self.logger.debug(
+                "%s() duration=%dns", name, int((time.monotonic() - start) * 1e9)
+            )
 
     # ------------------------------------------------------------------
     # anchor / reset / bootstrap (reference: src/hashgraph/hashgraph.go:1302-1410)

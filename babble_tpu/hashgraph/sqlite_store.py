@@ -69,7 +69,9 @@ class SQLiteStore(Store):
         self._need_bootstrap = existing_db
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.db = sqlite3.connect(path)
+        # access is serialized by the node's core_lock, so sharing the
+        # connection across the node's worker threads is safe
+        self.db = sqlite3.connect(path, check_same_thread=False)
         self.db.executescript(_SCHEMA)
 
         if existing_db:

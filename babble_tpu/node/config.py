@@ -1,0 +1,32 @@
+"""Node runtime configuration (reference: src/node/config.go).
+
+Durations are seconds (floats), not Go time.Durations.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+
+def _default_logger() -> logging.Logger:
+    return logging.getLogger("babble.node")
+
+
+@dataclass
+class Config:
+    heartbeat_timeout: float = 1.0
+    tcp_timeout: float = 1.0
+    cache_size: int = 500
+    sync_limit: int = 100
+    logger: logging.Logger = field(default_factory=_default_logger)
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast heartbeat for in-process integration tests
+    (reference: src/node/config.go:48-53 + test usage)."""
+    return Config(heartbeat_timeout=0.005, tcp_timeout=1.0, cache_size=1000, sync_limit=300)

@@ -1,0 +1,225 @@
+"""Per-node consensus façade: key ownership, head/seq tracking, tx and
+signature pools, wire conversion (reference: src/node/core.go:17-453)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto import pub_key_bytes
+from ..hashgraph import (
+    Block,
+    BlockSignature,
+    Event,
+    Frame,
+    Hashgraph,
+    Store,
+    WireEvent,
+)
+from ..peers import Peers
+
+
+class Core:
+    def __init__(
+        self,
+        id_: int,
+        key,
+        participants: Peers,
+        store: Store,
+        commit_ch: Optional["queue.Queue[Block]"] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.id = id_
+        self.key = key
+        self._pub_key: bytes = b""
+        self._hex_id: str = ""
+        self.logger = logger or logging.getLogger(f"babble.core.{id_}")
+        self.hg = Hashgraph(
+            participants,
+            store,
+            commit_callback=commit_ch.put if commit_ch is not None else None,
+            logger=self.logger,
+        )
+        self.participants = participants
+        self.head: str = ""
+        self.seq: int = -1
+        self.transaction_pool: List[bytes] = []
+        self.block_signature_pool: List[BlockSignature] = []
+
+    # -- identity ----------------------------------------------------------
+
+    def pub_key(self) -> bytes:
+        if not self._pub_key:
+            self._pub_key = pub_key_bytes(self.key)
+        return self._pub_key
+
+    def hex_id(self) -> str:
+        if not self._hex_id:
+            self._hex_id = "0x" + self.pub_key().hex().upper()
+        return self._hex_id
+
+    # -- head / bootstrap --------------------------------------------------
+
+    def set_head_and_seq(self) -> None:
+        last, is_root = self.hg.store.last_event_from(self.hex_id())
+        if is_root:
+            root = self.hg.store.get_root(self.hex_id())
+            self.head = root.self_parent.hash
+            self.seq = root.self_parent.index
+        else:
+            last_event = self.get_event(last)
+            self.head = last
+            self.seq = last_event.index()
+
+    def bootstrap(self) -> None:
+        self.hg.bootstrap()
+
+    # -- event insertion ---------------------------------------------------
+
+    def sign_and_insert_self_event(self, event: Event) -> None:
+        event.sign(self.key)
+        self.insert_event(event, True)
+
+    def insert_event(self, event: Event, set_wire_info: bool) -> None:
+        self.hg.insert_event(event, set_wire_info)
+        if event.creator() == self.hex_id():
+            self.head = event.hex()
+            self.seq = event.index()
+
+    def known_events(self) -> Dict[int, int]:
+        return self.hg.store.known_events()
+
+    # -- blocks ------------------------------------------------------------
+
+    def sign_block(self, block: Block) -> BlockSignature:
+        sig = block.sign(self.key)
+        block.set_signature(sig)
+        self.hg.store.set_block(block)
+        return sig
+
+    # -- sync --------------------------------------------------------------
+
+    def over_sync_limit(self, known_events: Dict[int, int], sync_limit: int) -> bool:
+        tot_unknown = 0
+        for pid, li in self.known_events().items():
+            other = known_events.get(pid, 0)
+            if li > other:
+                tot_unknown += li - other
+        return tot_unknown > sync_limit
+
+    def get_anchor_block_with_frame(self) -> Tuple[Block, Frame]:
+        return self.hg.get_anchor_block_with_frame()
+
+    def event_diff(self, known: Dict[int, int]) -> List[Event]:
+        """Events we know about that the peer (whose view is `known`) does not,
+        in topological order (reference: src/node/core.go:184-207)."""
+        unknown: List[Event] = []
+        for pid, ct in known.items():
+            peer = self.participants.by_id.get(pid)
+            if peer is None:
+                continue
+            for h in self.hg.store.participant_events(peer.pub_key_hex, ct):
+                unknown.append(self.hg.store.get_event(h))
+        unknown.sort(key=lambda e: e.topological_index)
+        return unknown
+
+    def sync(self, unknown_events: List[WireEvent]) -> None:
+        """Insert a batch of wire events, then record the sync with a new
+        self-event whose other-parent is the batch head
+        (reference: src/node/core.go:209-238)."""
+        other_head = ""
+        for k, we in enumerate(unknown_events):
+            ev = self.hg.read_wire_info(we)
+            self.insert_event(ev, False)
+            if k == len(unknown_events) - 1:
+                other_head = ev.hex()
+        self.add_self_event(other_head)
+
+    def fast_forward(self, peer: str, block: Block, frame: Frame) -> None:
+        self.hg.check_block(block)
+        if block.frame_hash() != frame.hash():
+            raise ValueError("Invalid Frame Hash")
+        self.hg.reset(block, frame)
+        self.set_head_and_seq()
+        self.run_consensus()
+
+    def add_self_event(self, other_head: str) -> None:
+        if (
+            other_head == ""
+            and not self.transaction_pool
+            and not self.block_signature_pool
+        ):
+            return
+        new_head = Event(
+            transactions=self.transaction_pool,
+            block_signatures=self.block_signature_pool,
+            parents=[self.head, other_head],
+            creator=self.pub_key(),
+            index=self.seq + 1,
+        )
+        self.sign_and_insert_self_event(new_head)
+        self.transaction_pool = []
+        self.block_signature_pool = []
+
+    def from_wire(self, wire_events: List[WireEvent]) -> List[Event]:
+        return [self.hg.read_wire_info(w) for w in wire_events]
+
+    def to_wire(self, events: List[Event]) -> List[WireEvent]:
+        return [e.to_wire() for e in events]
+
+    # -- consensus ---------------------------------------------------------
+
+    def run_consensus(self) -> None:
+        self.hg.run_consensus()
+
+    def add_transactions(self, txs: List[bytes]) -> None:
+        self.transaction_pool.extend(txs)
+
+    def add_block_signature(self, bs: BlockSignature) -> None:
+        self.block_signature_pool.append(bs)
+
+    # -- accessors ---------------------------------------------------------
+
+    def get_head(self) -> Event:
+        return self.hg.store.get_event(self.head)
+
+    def get_event(self, hash_: str) -> Event:
+        return self.hg.store.get_event(hash_)
+
+    def get_consensus_events(self) -> List[str]:
+        return self.hg.store.consensus_events()
+
+    def get_consensus_events_count(self) -> int:
+        return self.hg.store.consensus_events_count()
+
+    def get_undetermined_events(self) -> List[str]:
+        return self.hg.undetermined_events
+
+    def get_pending_loaded_events(self) -> int:
+        return self.hg.pending_loaded_events
+
+    def get_consensus_transactions(self) -> List[bytes]:
+        txs: List[bytes] = []
+        for e in self.get_consensus_events():
+            txs.extend(self.get_event(e).transactions())
+        return txs
+
+    def get_last_consensus_round_index(self) -> Optional[int]:
+        return self.hg.last_consensus_round
+
+    def get_consensus_transactions_count(self) -> int:
+        return self.hg.consensus_transactions
+
+    def get_last_committed_round_events_count(self) -> int:
+        return self.hg.last_committed_round_events
+
+    def get_last_block_index(self) -> int:
+        return self.hg.store.last_block_index()
+
+    def need_gossip(self) -> bool:
+        return (
+            self.hg.pending_loaded_events > 0
+            or len(self.transaction_pool) > 0
+            or len(self.block_signature_pool) > 0
+        )

@@ -1,0 +1,410 @@
+"""Top-level node runtime (reference: src/node/node.go).
+
+A Node runs three cooperating loops, mapped from the reference's goroutines
+onto daemon threads:
+
+- the state-machine loop (`run`): Babbling -> babble(), CatchingUp ->
+  fast_forward(), Shutdown -> return;
+- the background dispatcher (`_do_background_work`): a unified work queue
+  fed by forwarder threads draining the transport consumer, the app submit
+  queue and the consensus commit queue — the Python rendition of Go's
+  select over four channels (reference: src/node/node.go:144-174);
+- the control timer driving gossip ticks.
+
+`core_lock` serializes all Core/Hashgraph access, exactly like the
+reference's coreLock (src/node/node.go:27).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..hashgraph import Block, Store, WireEvent
+from ..net import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    RPC,
+    SyncRequest,
+    SyncResponse,
+    Transport,
+)
+from ..peers import Peers
+from ..proxy import AppProxy
+from .config import Config
+from .control_timer import new_random_control_timer
+from .core import Core
+from .peer_selector import RandomPeerSelector
+from .state import NodeState, NodeStateMachine
+
+
+class Node(NodeStateMachine):
+    def __init__(
+        self,
+        conf: Config,
+        id_: int,
+        key,
+        participants: Peers,
+        store: Store,
+        trans: Transport,
+        proxy: AppProxy,
+    ):
+        super().__init__()
+        self.conf = conf
+        self.id = id_
+        self.logger = logging.LoggerAdapter(conf.logger, {"this_id": id_})
+        self.local_addr = trans.local_addr()
+
+        pmap = store.participants()
+        self.commit_ch: "queue.Queue[Block]" = queue.Queue(maxsize=400)
+        self.core = Core(id_, key, pmap, store, self.commit_ch, conf.logger)
+        self.core_lock = threading.Lock()
+        self.selector_lock = threading.Lock()
+        self.peer_selector = RandomPeerSelector(participants, self.local_addr)
+        self.trans = trans
+        self.net_ch = trans.consumer()
+        self.proxy = proxy
+        self.submit_ch = proxy.submit_ch()
+        self.shutdown_event = threading.Event()
+        self.control_timer = new_random_control_timer(conf.heartbeat_timeout)
+
+        self.start_time = time.monotonic()
+        self.sync_requests = 0
+        self.sync_errors = 0
+
+        self.need_bootstrap = store.need_bootstrap()
+        self.set_starting(True)
+        self.set_state(NodeState.BABBLING)
+
+        self._work: "queue.Queue[Tuple[str, object]]" = queue.Queue()
+        self._run_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def init(self) -> None:
+        if self.need_bootstrap:
+            self.logger.debug("Bootstrap")
+            self.core.bootstrap()
+        self.core.set_head_and_seq()
+
+    def run_async(self, gossip: bool) -> None:
+        self._run_thread = threading.Thread(
+            target=self.run, args=(gossip,), name=f"node-{self.id}", daemon=True
+        )
+        self._run_thread.start()
+
+    def run(self, gossip: bool) -> None:
+        self.start_time = time.monotonic()
+        self.control_timer.run()
+
+        for src, tag in (
+            (self.net_ch, "rpc"),
+            (self.submit_ch, "tx"),
+            (self.commit_ch, "block"),
+        ):
+            threading.Thread(
+                target=self._forward, args=(src, tag), daemon=True,
+                name=f"node-{self.id}-fwd-{tag}",
+            ).start()
+        threading.Thread(
+            target=self._do_background_work, daemon=True,
+            name=f"node-{self.id}-background",
+        ).start()
+
+        while True:
+            state = self.get_state()
+            if state == NodeState.BABBLING:
+                self._babble(gossip)
+            elif state == NodeState.CATCHING_UP:
+                self.fast_forward()
+            elif state == NodeState.SHUTDOWN:
+                return
+
+    def _forward(self, src: "queue.Queue", tag: str) -> None:
+        while not self.shutdown_event.is_set():
+            try:
+                item = src.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._work.put((tag, item))
+
+    def _do_background_work(self) -> None:
+        while not self.shutdown_event.is_set():
+            try:
+                tag, item = self._work.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if tag == "rpc":
+                rpc = item
+
+                def handle(rpc=rpc):
+                    self._process_rpc(rpc)
+                    if self.core.need_gossip() and not self.control_timer.set:
+                        self.control_timer.reset()
+
+                self.go_func(handle, name=f"node-{self.id}-rpc")
+            elif tag == "tx":
+                self._add_transaction(item)
+                if not self.control_timer.set:
+                    self.control_timer.reset()
+            elif tag == "block":
+                try:
+                    self.commit(item)
+                except Exception as e:  # commit errors are logged, not fatal
+                    self.logger.error("Committing Block: %s", e)
+
+    def _babble(self, gossip: bool) -> None:
+        """Heartbeat loop in the Babbling state
+        (reference: src/node/node.go:180-204)."""
+        return_event = threading.Event()
+        while True:
+            if self.shutdown_event.is_set() or self.get_state() != NodeState.BABBLING:
+                return
+            if return_event.is_set():
+                return
+            try:
+                self.control_timer.tick_ch.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if gossip:
+                proceed = self._pre_gossip()
+                if proceed:
+                    peer = self.peer_selector.next()
+                    self.go_func(
+                        lambda addr=peer.net_addr: self._gossip(addr, return_event),
+                        name=f"node-{self.id}-gossip",
+                    )
+            if not self.core.need_gossip():
+                self.control_timer.stop()
+            elif not self.control_timer.set:
+                self.control_timer.reset()
+
+    # ------------------------------------------------------------------
+    # RPC handlers
+    # ------------------------------------------------------------------
+
+    def _process_rpc(self, rpc: RPC) -> None:
+        state = self.get_state()
+        if state != NodeState.BABBLING:
+            self.logger.debug("Discarding RPC Request in state %s", state)
+            rpc.respond(SyncResponse(from_id=self.id), error=f"not ready: {state}")
+            return
+        cmd = rpc.command
+        if isinstance(cmd, SyncRequest):
+            self._process_sync_request(rpc, cmd)
+        elif isinstance(cmd, EagerSyncRequest):
+            self._process_eager_sync_request(rpc, cmd)
+        elif isinstance(cmd, FastForwardRequest):
+            self._process_fast_forward_request(rpc, cmd)
+        else:
+            rpc.respond(None, error="unexpected command")
+
+    def _process_sync_request(self, rpc: RPC, cmd: SyncRequest) -> None:
+        resp = SyncResponse(from_id=self.id)
+        resp_err: Optional[str] = None
+
+        with self.core_lock:
+            over_sync_limit = self.core.over_sync_limit(cmd.known, self.conf.sync_limit)
+        if over_sync_limit:
+            self.logger.debug("SyncLimit")
+            resp.sync_limit = True
+        else:
+            try:
+                with self.core_lock:
+                    diff = self.core.event_diff(cmd.known)
+                resp.events = self.core.to_wire(diff)
+            except Exception as e:
+                self.logger.error("Calculating Diff: %s", e)
+                resp_err = str(e)
+
+        with self.core_lock:
+            resp.known = self.core.known_events()
+        rpc.respond(resp, error=resp_err)
+
+    def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
+        success = True
+        err: Optional[str] = None
+        with self.core_lock:
+            try:
+                self.sync(cmd.events)
+            except Exception as e:
+                self.logger.error("sync(): %s", e)
+                success = False
+                err = str(e)
+        rpc.respond(EagerSyncResponse(from_id=self.id, success=success), error=err)
+
+    def _process_fast_forward_request(self, rpc: RPC, cmd: FastForwardRequest) -> None:
+        resp = FastForwardResponse(from_id=self.id)
+        resp_err: Optional[str] = None
+        try:
+            with self.core_lock:
+                block, frame = self.core.get_anchor_block_with_frame()
+            resp.block = block
+            resp.frame = frame
+            resp.snapshot = self.proxy.get_snapshot(block.index())
+        except Exception as e:
+            self.logger.error("FastForwardRequest: %s", e)
+            resp_err = str(e)
+        rpc.respond(resp, error=resp_err)
+
+    # ------------------------------------------------------------------
+    # gossip
+    # ------------------------------------------------------------------
+
+    def _pre_gossip(self) -> bool:
+        with self.core_lock:
+            if not (self.core.need_gossip() or self.is_starting()):
+                return False
+            return True
+
+    def _gossip(self, peer_addr: str, return_event: threading.Event) -> None:
+        """One pull+push exchange (reference: src/node/node.go:363-395)."""
+        self.sync_requests += 1
+        try:
+            sync_limit, other_known = self._pull(peer_addr)
+            if sync_limit:
+                self.logger.debug("SyncLimit from %s", peer_addr)
+                self.set_state(NodeState.CATCHING_UP)
+                return_event.set()
+                return
+            self._push(peer_addr, other_known)
+        except Exception as e:
+            self.sync_errors += 1
+            self.logger.error("gossip(%s): %s", peer_addr, e)
+            return
+
+        with self.selector_lock:
+            self.peer_selector.update_last(peer_addr)
+        self.log_stats()
+        self.set_starting(False)
+
+    def _pull(self, peer_addr: str) -> Tuple[bool, Dict[int, int]]:
+        with self.core_lock:
+            known = self.core.known_events()
+        resp = self.trans.sync(peer_addr, SyncRequest(from_id=self.id, known=known))
+        if resp.sync_limit:
+            return True, {}
+        if resp.events:
+            with self.core_lock:
+                self.sync(resp.events)
+        return False, resp.known
+
+    def _push(self, peer_addr: str, known_events: Dict[int, int]) -> None:
+        with self.core_lock:
+            self.core.add_self_event("")
+        with self.core_lock:
+            if self.core.over_sync_limit(known_events, self.conf.sync_limit):
+                self.logger.debug("SyncLimit")
+                return
+            diff = self.core.event_diff(known_events)
+        wire_events = self.core.to_wire(diff)
+        self.trans.eager_sync(
+            peer_addr, EagerSyncRequest(from_id=self.id, events=wire_events)
+        )
+
+    def fast_forward(self) -> None:
+        """Catch-up via a peer's anchor block + frame + app snapshot
+        (reference: src/node/node.go:494-541)."""
+        self.logger.debug("IN CATCHING-UP STATE")
+        self.wait_routines()
+
+        peer = self.peer_selector.next()
+        try:
+            resp = self.trans.fast_forward(
+                peer.net_addr, FastForwardRequest(from_id=self.id)
+            )
+            with self.core_lock:
+                self.core.fast_forward(peer.pub_key_hex, resp.block, resp.frame)
+            self.proxy.restore(resp.snapshot)
+        except Exception as e:
+            self.logger.error("fast_forward: %s", e)
+            time.sleep(self.conf.heartbeat_timeout)
+            return
+
+        self.logger.debug("Fast-Forward OK")
+        self.set_state(NodeState.BABBLING)
+        self.set_starting(True)
+
+    # ------------------------------------------------------------------
+    # sync / commit / transactions
+    # ------------------------------------------------------------------
+
+    def sync(self, events) -> None:
+        """Insert events then run the 5-pass pipeline. Caller must hold
+        core_lock (reference: src/node/node.go:583-603)."""
+        self.core.sync(events)
+        self.core.run_consensus()
+
+    def commit(self, block: Block) -> None:
+        state_hash = self.proxy.commit_block(block)
+        block.body.state_hash = state_hash
+        with self.core_lock:
+            sig = self.core.sign_block(block)
+            self.core.add_block_signature(sig)
+
+    def _add_transaction(self, tx: bytes) -> None:
+        with self.core_lock:
+            self.core.add_transactions([tx])
+
+    def shutdown(self) -> None:
+        if self.get_state() == NodeState.SHUTDOWN:
+            return
+        self.logger.debug("Shutdown")
+        self.set_state(NodeState.SHUTDOWN)
+        self.shutdown_event.set()
+        self.wait_routines()
+        self.control_timer.shutdown()
+        self.trans.close()
+        self.core.hg.store.close()
+        if self._run_thread is not None and self._run_thread is not threading.current_thread():
+            self._run_thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------------
+    # stats
+    # ------------------------------------------------------------------
+
+    def get_stats(self) -> Dict[str, str]:
+        elapsed = time.monotonic() - self.start_time
+        consensus_events = self.core.get_consensus_events_count()
+        events_per_second = consensus_events / elapsed if elapsed > 0 else 0.0
+        last_consensus_round = self.core.get_last_consensus_round_index()
+        rounds_per_second = (
+            last_consensus_round / elapsed
+            if last_consensus_round is not None and elapsed > 0
+            else 0.0
+        )
+        return {
+            "last_consensus_round": (
+                "nil" if last_consensus_round is None else str(last_consensus_round)
+            ),
+            "last_block_index": str(self.core.get_last_block_index()),
+            "consensus_events": str(consensus_events),
+            "consensus_transactions": str(self.core.get_consensus_transactions_count()),
+            "undetermined_events": str(len(self.core.get_undetermined_events())),
+            "transaction_pool": str(len(self.core.transaction_pool)),
+            "num_peers": str(len(self.peer_selector.peers())),
+            "sync_rate": f"{self.sync_rate():.2f}",
+            "events_per_second": f"{events_per_second:.2f}",
+            "rounds_per_second": f"{rounds_per_second:.2f}",
+            "round_events": str(self.core.get_last_committed_round_events_count()),
+            "id": str(self.id),
+            "state": str(self.get_state()),
+        }
+
+    def log_stats(self) -> None:
+        self.logger.debug("Stats %s", self.get_stats())
+
+    def sync_rate(self) -> float:
+        if self.sync_requests == 0:
+            return 1.0
+        return 1.0 - self.sync_errors / self.sync_requests
+
+    def get_block(self, block_index: int) -> Block:
+        return self.core.hg.store.get_block(block_index)

@@ -1,0 +1,11 @@
+from .proxy import AppProxy, ProxyHandler
+from .inmem_proxy import InmemAppProxy
+from .dummy import InmemDummyClient, State
+
+__all__ = [
+    "AppProxy",
+    "ProxyHandler",
+    "InmemAppProxy",
+    "InmemDummyClient",
+    "State",
+]

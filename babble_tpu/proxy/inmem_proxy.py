@@ -1,0 +1,31 @@
+"""In-process AppProxy backed by a ProxyHandler
+(reference: src/proxy/inmem/inmem_proxy.go)."""
+
+from __future__ import annotations
+
+import queue
+
+from ..hashgraph import Block
+from .proxy import AppProxy, ProxyHandler
+
+
+class InmemAppProxy(AppProxy):
+    def __init__(self, handler: ProxyHandler):
+        self.handler = handler
+        self._submit: "queue.Queue[bytes]" = queue.Queue()
+
+    def submit_tx(self, tx: bytes) -> None:
+        # defensive copy: the caller may mutate its buffer after submit
+        self._submit.put(bytes(tx))
+
+    def submit_ch(self) -> "queue.Queue[bytes]":
+        return self._submit
+
+    def commit_block(self, block: Block) -> bytes:
+        return self.handler.commit_handler(block)
+
+    def get_snapshot(self, block_index: int) -> bytes:
+        return self.handler.snapshot_handler(block_index)
+
+    def restore(self, snapshot: bytes) -> bytes:
+        return self.handler.restore_handler(snapshot)
