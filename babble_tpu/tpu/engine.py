@@ -1,0 +1,207 @@
+"""Device consensus engine: drives the JAX kernels over a DagGrid and
+writes results back into a host Hashgraph, making the TPU path a drop-in
+replacement for the scalar five-pass pipeline
+(reference: src/node/core.go:335-377).
+
+The division of labor follows the north star in BASELINE.json: the host
+keeps ownership of the DAG, store, crypto and blockchain projection;
+the O(rounds x witnesses^2 x N) virtual-voting analysis runs on device.
+Frames/blocks are then assembled by the unchanged host code so consensus
+output is byte-identical by construction once rounds/fame/received match.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .grid import DagGrid, GridUnsupported, grid_from_hashgraph
+from . import kernels
+
+
+@dataclass
+class PassResults:
+    """Device results staged back to host numpy."""
+
+    rounds: np.ndarray  # (E,)
+    witness: np.ndarray  # (E,)
+    lamport: np.ndarray  # (E,)
+    witness_table: np.ndarray  # (R, N)
+    fame_decided: np.ndarray  # (R, N)
+    famous: np.ndarray  # (R, N)
+    rounds_decided: np.ndarray  # (R,)
+    received: np.ndarray  # (E,)
+    last_round: int
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two to amortize recompilation across batch
+    sizes (XLA wants static shapes)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def run_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
+    """Run DivideRounds + DecideFame + DecideRoundReceived on device."""
+    import jax.numpy as jnp
+
+    r_max = grid.r_max
+
+    # upload the shared inputs once; the coordinate matrices are the large
+    # buffers (E x N int32) consumed by all three kernels
+    la = jnp.asarray(grid.last_ancestors)
+    fd = jnp.asarray(grid.first_descendants)
+    index = jnp.asarray(grid.index)
+    creator = jnp.asarray(grid.creator)
+
+    dr = kernels.divide_rounds(
+        jnp.asarray(grid.levels),
+        creator,
+        index,
+        jnp.asarray(grid.self_parent),
+        jnp.asarray(grid.other_parent),
+        la,
+        fd,
+        jnp.asarray(grid.root_next_round),
+        jnp.asarray(grid.root_sp_round),
+        jnp.asarray(grid.root_sp_lamport),
+        grid.super_majority,
+        r_max,
+    )
+    rounds_np = np.asarray(dr.rounds)
+    last_round = int(rounds_np.max(initial=-1))
+
+    # offsets must span to the last round for bit-exactness with the
+    # reference's j-loop (reference: hashgraph.go:868-931); bucketed so the
+    # kernel is reused across growing DAGs
+    span = d_max if d_max is not None else _bucket(max(last_round, 1))
+
+    fame = kernels.decide_fame(
+        dr.witness_table,
+        la,
+        fd,
+        index,
+        jnp.asarray(grid.coin_bit),
+        jnp.int32(last_round),
+        grid.super_majority,
+        grid.n,
+        span,
+    )
+
+    received = kernels.decide_round_received(
+        dr.witness_table,
+        la,
+        index,
+        creator,
+        dr.rounds,
+        fame.decided,
+        fame.famous,
+        fame.rounds_decided,
+        jnp.int32(last_round),
+    )
+
+    return PassResults(
+        rounds=rounds_np,
+        witness=np.asarray(dr.witness),
+        lamport=np.asarray(dr.lamport),
+        witness_table=np.asarray(dr.witness_table),
+        fame_decided=np.asarray(fame.decided),
+        famous=np.asarray(fame.famous),
+        rounds_decided=np.asarray(fame.rounds_decided),
+        received=np.asarray(received),
+        last_round=last_round,
+    )
+
+
+def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
+    """Full five-pass pipeline with passes 1-3 on device.
+
+    Equivalent to Hashgraph.run_consensus() on a freshly-inserted DAG:
+    extract grid -> device passes -> write rounds/witness/lamport/fame/
+    received back into the store -> host ProcessDecidedRounds +
+    ProcessSigPool (unchanged, so blocks come out byte-identical).
+    """
+    from ..common import StoreErr, StoreErrType, is_store_err
+    from ..hashgraph import RoundInfo, PendingRound
+
+    grid = grid_from_hashgraph(hg)
+    if grid.e == 0:
+        hg.process_decided_rounds()
+        hg.process_sig_pool()
+        return
+    res = run_passes(grid, d_max=d_max)
+
+    # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
+    undetermined = set(hg.undetermined_events)
+    row_of = {h: r for r, h in enumerate(grid.hashes)}
+    round_infos = {}
+    for r in range(grid.e):  # rows are topo-ordered
+        h = grid.hashes[r]
+        ev = hg.store.get_event(h)
+        ev.set_round(int(res.rounds[r]))
+        ev.set_lamport_timestamp(int(res.lamport[r]))
+        hg.store.set_event(ev)
+        if h in undetermined:
+            rnum = int(res.rounds[r])
+            ri = round_infos.get(rnum)
+            if ri is None:
+                try:
+                    ri = hg.store.get_round(rnum)
+                except StoreErr as err:
+                    if not is_store_err(err, StoreErrType.KEY_NOT_FOUND):
+                        raise
+                    ri = RoundInfo()
+                round_infos[rnum] = ri
+            if not ri.queued and (
+                hg.last_consensus_round is None or rnum >= hg.last_consensus_round
+            ):
+                hg.pending_rounds.append(PendingRound(rnum, False))
+                ri.queued = True
+            ri.add_event(h, bool(res.witness[r]))
+
+    # --- write-back: DecideFame (reference: hashgraph.go:852-947) ---
+    decided_rounds = set()
+    for pr in hg.pending_rounds:
+        ri = round_infos.get(pr.index)
+        if ri is None:
+            ri = hg.store.get_round(pr.index)
+            round_infos[pr.index] = ri
+        for c in range(grid.n):
+            wrow = int(res.witness_table[pr.index, c])
+            if wrow < 0:
+                continue
+            if res.fame_decided[pr.index, c]:
+                ri.set_fame(grid.hashes[wrow], bool(res.famous[pr.index, c]))
+        if ri.witnesses_decided():
+            decided_rounds.add(pr.index)
+    for pr in hg.pending_rounds:
+        if pr.index in decided_rounds:
+            pr.decided = True
+
+    # --- write-back: DecideRoundReceived (reference: hashgraph.go:951-1036) ---
+    new_undetermined = []
+    for h in hg.undetermined_events:
+        rr = int(res.received[row_of[h]])
+        if rr >= 0:
+            ev = hg.store.get_event(h)
+            ev.set_round_received(rr)
+            hg.store.set_event(ev)
+            tri = round_infos.get(rr)
+            if tri is None:
+                tri = hg.store.get_round(rr)
+                round_infos[rr] = tri
+            tri.set_consensus_event(h)
+        else:
+            new_undetermined.append(h)
+    hg.undetermined_events = new_undetermined
+
+    for rnum, ri in round_infos.items():
+        hg.store.set_round(rnum, ri)
+
+    # --- host passes 4-5 ---
+    hg.process_decided_rounds()
+    hg.process_sig_pool()

@@ -1,0 +1,269 @@
+"""Dense device representation of the gossip DAG.
+
+The hashgraph's per-event `lastAncestors` / `firstDescendants` coordinate
+vectors (reference: src/hashgraph/event.go:115-116, hashgraph.go:439-544)
+become two (E, N) int32 matrices; events become rows identified by
+(creator position, per-creator index) — the wire-int encoding
+(reference: src/hashgraph/event.go:353-368) promoted to grid coordinates.
+No hashes live on device; the only hash-derived value shipped is the
+precomputed coin-round bit per event (reference:
+src/hashgraph/hashgraph.go:1526-1535), which is consensus-critical.
+
+Events are laid out in *topological levels*: level(e) = 1 + max(level of
+parents). Ancestors always occupy strictly lower levels, and a creator has
+at most one event per level (the self-parent sits one level down), so each
+level holds <= N events and the whole DAG processes as a scan over levels
+with all within-level work vectorized — the TPU-native replacement for the
+reference's per-event recursion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MAX_INT32 = 2**31 - 1
+
+
+@dataclass
+class DagGrid:
+    """Host-side numpy staging of one consensus batch."""
+
+    n: int  # validators
+    e: int  # events
+    super_majority: int
+    creator: np.ndarray  # (E,) int32 peer position
+    index: np.ndarray  # (E,) int32 per-creator sequence number
+    self_parent: np.ndarray  # (E,) int32 event row, -1 = attached to root
+    other_parent: np.ndarray  # (E,) int32 event row, -1 = none
+    last_ancestors: np.ndarray  # (E, N) int32
+    first_descendants: np.ndarray  # (E, N) int32 (MAX_INT32 = none)
+    coin_bit: np.ndarray  # (E,) bool
+    root_next_round: np.ndarray  # (N,) int32
+    root_sp_round: np.ndarray  # (N,) int32
+    root_sp_lamport: np.ndarray  # (N,) int32
+    levels: np.ndarray  # (L, N) int32 event rows, -1 padding
+    num_levels: int
+    hashes: Optional[List[str]] = None  # row -> event hex (host bookkeeping)
+
+    @property
+    def r_max(self) -> int:
+        # round(e) <= level(e) + max root next_round (see module docstring)
+        return self.num_levels + int(self.root_next_round.max(initial=0)) + 2
+
+
+class GridUnsupported(Exception):
+    """Raised when a hashgraph state cannot be expressed as a dense grid
+    (e.g. post-reset roots with `others` entries) — callers fall back to
+    the CPU engine."""
+
+
+def grid_from_hashgraph(hg) -> DagGrid:
+    """Extract the dense grid from a host Hashgraph's store.
+
+    Only undetermined-from-scratch hashgraphs with base-style roots are
+    supported; frames/reset roots carry `others` entries and raise
+    GridUnsupported.
+    """
+    from ..hashgraph.hashgraph import middle_bit
+
+    participants = hg.participants.to_peer_slice()
+    n = len(participants)
+
+    root_next_round = np.full(n, 0, dtype=np.int32)
+    root_sp_round = np.full(n, -1, dtype=np.int32)
+    root_sp_lamport = np.full(n, -1, dtype=np.int32)
+    for pos, p in enumerate(participants):
+        root = hg.store.get_root(p.pub_key_hex)
+        if root.others:
+            raise GridUnsupported("roots with `others` entries (post-reset state)")
+        root_next_round[pos] = root.next_round
+        root_sp_round[pos] = root.self_parent.round
+        root_sp_lamport[pos] = root.self_parent.lamport_timestamp
+
+    events = []
+    for p in participants:
+        for h in hg.store.participant_events(p.pub_key_hex, -1):
+            events.append(hg.store.get_event(h))
+    events.sort(key=lambda ev: ev.topological_index)
+
+    e_count = len(events)
+    row_of: Dict[str, int] = {ev.hex(): i for i, ev in enumerate(events)}
+
+    creator = np.zeros(e_count, dtype=np.int32)
+    index = np.zeros(e_count, dtype=np.int32)
+    self_parent = np.full(e_count, -1, dtype=np.int32)
+    other_parent = np.full(e_count, -1, dtype=np.int32)
+    la = np.full((e_count, n), -1, dtype=np.int32)
+    fd = np.full((e_count, n), MAX_INT32, dtype=np.int32)
+    coin = np.zeros(e_count, dtype=bool)
+    hashes = [ev.hex() for ev in events]
+
+    for i, ev in enumerate(events):
+        creator[i] = hg.peer_position(ev.creator())
+        index[i] = ev.index()
+        sp = ev.self_parent()
+        if sp in row_of:
+            self_parent[i] = row_of[sp]
+        op = ev.other_parent()
+        if op != "":
+            if op in row_of:
+                other_parent[i] = row_of[op]
+            else:
+                raise GridUnsupported(f"other-parent outside grid: {op[:18]}…")
+        la[i] = [c[0] for c in ev.last_ancestors]
+        fd[i] = [c[0] for c in ev.first_descendants]
+        coin[i] = middle_bit(ev.hex())
+
+    levels, num_levels = build_levels(n, self_parent, other_parent)
+
+    return DagGrid(
+        n=n,
+        e=e_count,
+        super_majority=hg.super_majority,
+        creator=creator,
+        index=index,
+        self_parent=self_parent,
+        other_parent=other_parent,
+        last_ancestors=la,
+        first_descendants=fd,
+        coin_bit=coin,
+        root_next_round=root_next_round,
+        root_sp_round=root_sp_round,
+        root_sp_lamport=root_sp_lamport,
+        levels=levels,
+        num_levels=num_levels,
+        hashes=hashes,
+    )
+
+
+def build_levels(n: int, self_parent: np.ndarray, other_parent: np.ndarray):
+    """Topological level table: (L, N) of event rows, -1 padded."""
+    e_count = len(self_parent)
+    level = np.zeros(e_count, dtype=np.int64)
+    for i in range(e_count):
+        lv = 0
+        sp = self_parent[i]
+        if sp >= 0:
+            lv = level[sp] + 1
+        op = other_parent[i]
+        if op >= 0:
+            lv = max(lv, level[op] + 1)
+        level[i] = lv
+
+    num_levels = int(level.max(initial=-1)) + 1 if e_count else 0
+    levels = np.full((max(num_levels, 1), n), -1, dtype=np.int32)
+    slot = np.zeros(max(num_levels, 1), dtype=np.int64)
+    for i in range(e_count):
+        lv = level[i]
+        levels[lv, slot[lv]] = i
+        slot[lv] += 1
+    return levels, num_levels
+
+
+def synthetic_grid(
+    n: int,
+    e_count: int,
+    seed: int = 0,
+    zipf_a: float = 0.0,
+) -> DagGrid:
+    """Generate a random gossip DAG the way gossip produces one: each new
+    event is a sync — creator c extends its own chain with an other-parent
+    drawn from another validator's head (Zipf-skewed fan-out when zipf_a>0,
+    reference scenario: BASELINE.json config #3).
+
+    Coordinates (lastAncestors/firstDescendants) are built exactly as the
+    host insert path does (reference: src/hashgraph/hashgraph.go:439-544).
+    Used by the offline replay bench and kernel tests; no signatures — the
+    synthetic coin bits are pseudorandom.
+    """
+    rng = np.random.default_rng(seed)
+    super_majority = 2 * n // 3 + 1
+
+    creator = np.zeros(e_count, dtype=np.int32)
+    index = np.zeros(e_count, dtype=np.int32)
+    self_parent = np.full(e_count, -1, dtype=np.int32)
+    other_parent = np.full(e_count, -1, dtype=np.int32)
+    la = np.full((e_count, n), -1, dtype=np.int32)
+    fd = np.full((e_count, n), MAX_INT32, dtype=np.int32)
+
+    head = np.full(n, -1, dtype=np.int64)  # validator -> head event row
+    next_index = np.zeros(n, dtype=np.int64)
+    rows_by = [[] for _ in range(n)]  # validator -> [index -> event row]
+
+    if zipf_a > 0:
+        weights = 1.0 / np.arange(1, n + 1) ** zipf_a
+        weights /= weights.sum()
+    else:
+        weights = np.full(n, 1.0 / n)
+
+    # first event per validator, then gossip syncs
+    for i in range(e_count):
+        if i < n:
+            c = i
+            op_row = -1
+        else:
+            c = int(rng.integers(n))
+            partner = int(rng.choice(n, p=weights))
+            while partner == c:
+                partner = int(rng.choice(n, p=weights))
+            op_row = int(head[partner])
+        creator[i] = c
+        index[i] = next_index[c]
+        self_parent[i] = head[c]
+        other_parent[i] = op_row
+
+        # merge parents' lastAncestors
+        sp_row = head[c]
+        if sp_row < 0 and op_row < 0:
+            pass  # stays all -1
+        elif sp_row < 0:
+            la[i] = la[op_row]
+        elif op_row < 0:
+            la[i] = la[sp_row]
+        else:
+            la[i] = np.maximum(la[sp_row], la[op_row])
+        la[i, c] = index[i]
+        fd[i, c] = index[i]
+
+        rows_by[c].append(i)  # before the walk: own fd cell is already set
+
+        # mark first descendants along ancestors' self-parent chains;
+        # amortized O(E*N): each (row, c) cell is written at most once
+        for p in range(n):
+            a = int(la[i, p])
+            while a >= 0:
+                row = rows_by[p][a]
+                if fd[row, c] == MAX_INT32:
+                    fd[row, c] = index[i]
+                    a -= 1
+                else:
+                    break
+
+        head[c] = i
+        next_index[c] += 1
+
+    coin = rng.integers(0, 2, size=e_count).astype(bool)
+    levels, num_levels = build_levels(n, self_parent, other_parent)
+
+    return DagGrid(
+        n=n,
+        e=e_count,
+        super_majority=super_majority,
+        creator=creator,
+        index=index,
+        self_parent=self_parent,
+        other_parent=other_parent,
+        last_ancestors=la,
+        first_descendants=fd,
+        coin_bit=coin,
+        root_next_round=np.zeros(n, dtype=np.int32),
+        root_sp_round=np.full(n, -1, dtype=np.int32),
+        root_sp_lamport=np.full(n, -1, dtype=np.int32),
+        levels=levels,
+        num_levels=num_levels,
+    )
+
+

@@ -1,0 +1,269 @@
+"""JAX/XLA consensus kernels: the five-pass virtual-voting pipeline as dense
+batched array programs.
+
+Bit-exactness contract: every kernel reproduces the host engine's results
+(rounds, witness flags, lamport timestamps, fame trileans, round-received)
+on any fork-free DAG — verified by the differential tests in
+tests/test_tpu_differential.py. The mapping from the reference algorithms
+(reference: src/hashgraph/hashgraph.go:767-1036):
+
+- stronglySee(x, y) = |{p : lastAnc[x][p] >= firstDesc[y][p]}| >= 2n/3+1
+  (reference: hashgraph.go:184-190) -> batched compare + reduce over the
+  trailing N axis.
+- DivideRounds -> lax.scan over topological *levels* (<= N events each,
+  ancestors strictly below), each step vectorized: parent-round max, then
+  strongly-see counts against the parent round's witness row of the
+  (R, N) witness table, then witness/lamport updates by scatter.
+- DecideFame -> one scan over the round-offset d, *batched over all rounds
+  i simultaneously*: votes[i] is an (N, N) creator-indexed matrix; the
+  vote count "yays(y,x) = sum_w stronglySee(y,w) * vote(w,x)"
+  (reference: hashgraph.go:886-911) is a batched (R, N, N) float matmul —
+  MXU work. Coin rounds substitute the precomputed event-hash middle bit
+  (reference: hashgraph.go:922-928,1526-1535).
+- DecideRoundReceived -> per-round famous-witness column minima of
+  lastAncestors: event e is seen by ALL famous witnesses of round i iff
+  index[e] <= min over famous w of lastAnc[w][creator[e]] — an (R, N)
+  table + an (E, R) masked argmin (reference: hashgraph.go:988-1001).
+
+All shapes static; padding rows are -1/masked.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_INT32 = 2**31 - 1
+NEG = jnp.int32(-1)
+
+
+class DivideRoundsResult(NamedTuple):
+    rounds: jax.Array  # (E,) int32
+    witness: jax.Array  # (E,) bool
+    lamport: jax.Array  # (E,) int32
+    witness_table: jax.Array  # (R, N) int32 event rows, -1 = none
+
+
+class FameResult(NamedTuple):
+    decided: jax.Array  # (R, N) bool — fame known for witness of (round, creator)
+    famous: jax.Array  # (R, N) bool — fame value where decided
+    rounds_decided: jax.Array  # (R,) bool — all witnesses of round decided
+
+
+@functools.partial(jax.jit, static_argnames=("r_max",))
+def divide_rounds(
+    levels: jax.Array,  # (L, N) int32 event rows, -1 padded
+    creator: jax.Array,  # (E,) int32
+    index: jax.Array,  # (E,) int32
+    self_parent: jax.Array,  # (E,) int32
+    other_parent: jax.Array,  # (E,) int32
+    la: jax.Array,  # (E, N) int32
+    fd: jax.Array,  # (E, N) int32
+    root_next_round: jax.Array,  # (N,) int32
+    root_sp_round: jax.Array,  # (N,) int32
+    root_sp_lamport: jax.Array,  # (N,) int32
+    super_majority: int,
+    r_max: int,
+) -> DivideRoundsResult:
+    e_count, n = la.shape
+
+    def step(carry, level_rows):
+        rounds, lamport, witness, wtable = carry
+        valid = level_rows >= 0
+        rows = jnp.maximum(level_rows, 0)
+        # scatter target: padding lanes go out of bounds and are dropped,
+        # so they can never collide with row 0's real update
+        scatter_rows = jnp.where(valid, rows, e_count)
+
+        c = creator[rows]  # (N,)
+        sp = self_parent[rows]
+        op = other_parent[rows]
+
+        sp_round = jnp.where(sp >= 0, rounds[jnp.maximum(sp, 0)], root_sp_round[c])
+        op_round = jnp.where(op >= 0, rounds[jnp.maximum(op, 0)], NEG)
+        parent_round = jnp.maximum(sp_round, op_round)
+
+        # strongly-see counts against the parent round's witnesses
+        wrows = wtable[jnp.clip(parent_round, 0, r_max - 1)]  # (N_lvl, N)
+        wvalid = (wrows >= 0) & (parent_round[:, None] >= 0)
+        fd_w = fd[jnp.maximum(wrows, 0)]  # (N_lvl, N, N)
+        la_e = la[rows]  # (N_lvl, N)
+        counts = jnp.sum(la_e[:, None, :] >= fd_w, axis=-1, dtype=jnp.int32)
+        ss = (counts >= super_majority) & wvalid
+        c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
+
+        new_round = parent_round + (c_seen >= super_majority).astype(jnp.int32)
+        # events attached directly to the root (no parents in the grid)
+        root_attached = (sp < 0) & (op < 0)
+        new_round = jnp.where(root_attached, root_next_round[c], new_round)
+
+        new_witness = new_round > sp_round
+
+        sp_lt = jnp.where(sp >= 0, lamport[jnp.maximum(sp, 0)], root_sp_lamport[c])
+        op_lt = jnp.where(op >= 0, lamport[jnp.maximum(op, 0)], -(2**31))
+        new_lt = jnp.maximum(sp_lt, op_lt) + 1
+
+        rounds = rounds.at[scatter_rows].set(new_round, mode="drop")
+        lamport = lamport.at[scatter_rows].set(new_lt, mode="drop")
+        witness = witness.at[scatter_rows].set(new_witness, mode="drop")
+
+        # scatter witnesses into the (R, N) table; non-witness lanes dropped
+        w_mask = valid & new_witness
+        wr = jnp.where(w_mask, jnp.clip(new_round, 0, r_max - 1), r_max)
+        wtable = wtable.at[wr, c].set(level_rows, mode="drop")
+        return (rounds, lamport, witness, wtable), None
+
+    init = (
+        jnp.full((e_count,), -1, dtype=jnp.int32),
+        jnp.full((e_count,), -1, dtype=jnp.int32),
+        jnp.zeros((e_count,), dtype=bool),
+        jnp.full((r_max, n), -1, dtype=jnp.int32),
+    )
+    (rounds, lamport, witness, wtable), _ = jax.lax.scan(step, init, levels)
+    return DivideRoundsResult(rounds, witness, lamport, wtable)
+
+
+@functools.partial(jax.jit, static_argnames=("super_majority", "n_participants", "d_max"))
+def decide_fame(
+    wtable: jax.Array,  # (R, N) int32 witness rows
+    la: jax.Array,  # (E, N)
+    fd: jax.Array,  # (E, N)
+    index: jax.Array,  # (E,)
+    coin_bit: jax.Array,  # (E,) bool
+    last_round: jax.Array,  # () int32
+    super_majority: int,
+    n_participants: int,
+    d_max: int,
+) -> FameResult:
+    """Virtual voting, batched over every round i at once; scan over the
+    round offset d (j = i + d)."""
+    r_max, n = wtable.shape
+    wvalid = wtable >= 0
+    wrows = jnp.maximum(wtable, 0)
+    la_w = la[wrows]  # (R, N, N) lastAncestors of each round's witnesses
+    fd_w = fd[wrows]  # (R, N, N)
+    idx_w = index[wrows]  # (R, N)
+    coin_w = coin_bit[wrows]  # (R, N)
+
+    # ss[j, y, w]: witness y of round j strongly sees witness w of round j-1
+    fd_prev = jnp.roll(fd_w, 1, axis=0)
+    counts = jnp.sum(la_w[:, :, None, :] >= fd_prev[:, None, :, :], axis=-1)
+    prev_valid = jnp.roll(wvalid, 1, axis=0).at[0].set(False)
+    ss = (counts >= super_majority) & wvalid[:, :, None] & prev_valid[:, None, :]
+
+    # votes at d=1: see(y of round i+1, x of round i) == ancestry
+    # (reference: hashgraph.go:879-884)
+    la_next = jnp.roll(la_w, -1, axis=0)  # (R, N_y, N_xc) la of round i+1
+    see0 = la_next >= idx_w[:, None, :]
+    valid_y0 = jnp.roll(wvalid, -1, axis=0).at[r_max - 1].set(False)
+    votes0 = see0 & valid_y0[:, :, None]
+
+    i_arr = jnp.arange(r_max)
+
+    def step(carry, d):
+        votes, decided, famous = carry
+        j = i_arr + d  # per-i absolute round of the voters
+        j_ok = j <= last_round
+        jc = jnp.clip(j, 0, r_max - 1)
+
+        ss_d = ss[jc] & j_ok[:, None, None]  # (R, N_y, N_w)
+        vy = wvalid[jc] & j_ok[:, None]  # voter validity (R, N_y)
+
+        yays = jnp.einsum(
+            "ryw,rwx->ryx",
+            ss_d.astype(jnp.float32),
+            votes.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)  # (R, N_y)
+        nays = total[:, :, None] - yays
+        v = yays >= nays
+        t = jnp.where(v, yays, nays)
+
+        is_coin = (d % n_participants) == 0
+        strong = t >= super_majority
+
+        decide_now = (
+            (~is_coin)
+            & strong
+            & vy[:, :, None]
+            & wvalid[:, None, :]
+            & (~decided[:, None, :])
+        )
+        any_decide = jnp.any(decide_now, axis=1)  # (R, N_x)
+        fame_val = jnp.any(decide_now & v, axis=1)
+        famous = jnp.where(any_decide, fame_val, famous)
+        decided = decided | any_decide
+
+        coin_votes = jnp.where(strong, v, coin_w[jc][:, :, None])
+        votes_next = jnp.where(is_coin, coin_votes, v)
+        return (votes_next, decided, famous), None
+
+    init = (
+        votes0,
+        jnp.zeros((r_max, n), dtype=bool),
+        jnp.zeros((r_max, n), dtype=bool),
+    )
+    ds = jnp.arange(2, d_max + 2)
+    (votes, decided, famous), _ = jax.lax.scan(step, init, ds)
+
+    # rounds with no witnesses at all don't exist; treat as not decided
+    rounds_decided = jnp.all(decided | ~wvalid, axis=1) & jnp.any(wvalid, axis=1)
+    return FameResult(decided, famous, rounds_decided)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def decide_round_received(
+    wtable: jax.Array,  # (R, N)
+    la: jax.Array,  # (E, N)
+    index: jax.Array,  # (E,)
+    creator: jax.Array,  # (E,)
+    rounds: jax.Array,  # (E,)
+    decided: jax.Array,  # (R, N) fame decided per witness
+    famous: jax.Array,  # (R, N) fame value
+    rounds_decided: jax.Array,  # (R,)
+    last_round: jax.Array,  # ()
+) -> jax.Array:
+    """Round-received per event; -1 when still undetermined.
+
+    received(e) = min { i > round(e) : every round in (round(e), i] is
+    fully fame-decided, round i has >= 1 famous witness, and all famous
+    witnesses of i see e } (reference: hashgraph.go:951-1036).
+    """
+    r_max, n = wtable.shape
+    e_count = la.shape[0]
+
+    is_famous = decided & famous & (wtable >= 0)  # (R, N)
+    famous_count = jnp.sum(is_famous, axis=1)  # (R,)
+
+    # min over famous witnesses of lastAnc[w][c] per (round, creator-column)
+    la_w = la[jnp.maximum(wtable, 0)]  # (R, N_w, N_c)
+    min_la = jnp.min(
+        jnp.where(is_famous[:, :, None], la_w, MAX_INT32), axis=1
+    )  # (R, N_c)
+
+    i_ok = rounds_decided & (jnp.arange(r_max) <= last_round)
+    # first non-decided round at-or-after k, as a suffix-scan:
+    # horizon[k] = min{ i >= k : not i_ok[i] }  (r_max if none)
+    idx = jnp.arange(r_max)
+    bad = jnp.where(~i_ok, idx, r_max)
+    horizon = jax.lax.associative_scan(jnp.minimum, bad, reverse=True)  # (R,)
+
+    # candidate matrix (E, R): event e received at round i?
+    seen_all = index[:, None] <= min_la[:, creator].T  # (E, R)
+    cand = (
+        seen_all
+        & (famous_count[None, :] > 0)
+        & i_ok[None, :]
+        & (idx[None, :] > rounds[:, None])
+    )
+    # prefix condition: every round in (rounds[e], i] decided ->
+    # i < horizon[rounds[e]+1]
+    start = jnp.clip(rounds + 1, 0, r_max - 1)
+    cand = cand & (idx[None, :] < horizon[start][:, None])
+
+    received = jnp.min(jnp.where(cand, idx[None, :], r_max), axis=1)
+    return jnp.where(received == r_max, -1, received).astype(jnp.int32)

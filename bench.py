@@ -1,0 +1,116 @@
+"""Benchmark of record: events/sec through the device DivideRounds +
+DecideFame + DecideRoundReceived pipeline at 64 validators (BASELINE.md
+north-star config; reference harness: src/hashgraph/hashgraph_test.go:1522,
+which publishes no absolute numbers — the target is BASELINE.json's
+1M pending events/sec on a single chip).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is value / 1e6 (the BASELINE.json target, since the reference
+publishes no numbers of its own).
+
+Runs on whatever JAX platform is available (real TPU under the driver).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_VALIDATORS = 64
+N_EVENTS = 32768
+SEED = 0
+TARGET_EVENTS_PER_SEC = 1_000_000.0
+
+CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "bench_cache",
+    f"grid_{N_VALIDATORS}x{N_EVENTS}_seed{SEED}.npz",
+)
+
+
+def load_grid():
+    import numpy as np
+
+    from babble_tpu.tpu.grid import DagGrid, build_levels, synthetic_grid
+
+    if os.path.exists(CACHE):
+        z = np.load(CACHE)
+        levels, num_levels = build_levels(
+            N_VALIDATORS, z["self_parent"], z["other_parent"]
+        )
+        return DagGrid(
+            n=N_VALIDATORS,
+            e=N_EVENTS,
+            super_majority=2 * N_VALIDATORS // 3 + 1,
+            creator=z["creator"],
+            index=z["index"],
+            self_parent=z["self_parent"],
+            other_parent=z["other_parent"],
+            last_ancestors=z["la"],
+            first_descendants=z["fd"],
+            coin_bit=z["coin"],
+            root_next_round=np.zeros(N_VALIDATORS, dtype=np.int32),
+            root_sp_round=np.full(N_VALIDATORS, -1, dtype=np.int32),
+            root_sp_lamport=np.full(N_VALIDATORS, -1, dtype=np.int32),
+            levels=levels,
+            num_levels=num_levels,
+        )
+
+    grid = synthetic_grid(N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=1.1)
+    os.makedirs(os.path.dirname(CACHE), exist_ok=True)
+    np.savez_compressed(
+        CACHE,
+        creator=grid.creator,
+        index=grid.index,
+        self_parent=grid.self_parent,
+        other_parent=grid.other_parent,
+        la=grid.last_ancestors,
+        fd=grid.first_descendants,
+        coin=grid.coin_bit,
+    )
+    return grid
+
+
+def main():
+    import jax
+
+    from babble_tpu.tpu.engine import run_passes
+
+    grid = load_grid()
+
+    # warm-up: compile + first run
+    res = run_passes(grid)
+    assert res.last_round > 0, "synthetic DAG failed to advance rounds"
+    assert res.rounds_decided[: max(res.last_round - 6, 0)].all(), (
+        "fame undecided in settled region"
+    )
+
+    iters = 5
+    start = time.perf_counter()
+    for _ in range(iters):
+        res = run_passes(grid)
+    elapsed = (time.perf_counter() - start) / iters
+
+    events_per_sec = grid.e / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "events ordered/sec through device "
+                    "DivideRounds+DecideFame+DecideRoundReceived, "
+                    f"{N_VALIDATORS} validators, {N_EVENTS} events, "
+                    f"platform={jax.devices()[0].platform}"
+                ),
+                "value": round(events_per_sec, 1),
+                "unit": "events/s",
+                "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -3,17 +3,24 @@
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
 run without TPU hardware (the driver separately dry-run-compiles the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this image's interpreter pre-imports jax from sitecustomize against
+the real TPU tunnel, so env vars alone are too late — jax.config.update
+before the first backend use is what sticks.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,197 @@
+"""Differential oracle: the device consensus kernels must produce exactly
+the host engine's rounds / witness flags / lamport timestamps / fame /
+round-received — and byte-identical blocks — on every fixture.
+
+This is the fourth load-bearing test idea on top of the reference's three
+(play DSL, named topologies, block byte-equality; reference:
+src/hashgraph/hashgraph_test.go): CPU pass vs TPU pass on the same DAG.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+from babble_tpu.tpu import grid_from_hashgraph, run_passes, run_consensus_device, synthetic_grid
+from babble_tpu.tpu.grid import MAX_INT32
+
+from dsl import (
+    init_consensus_hashgraph,
+    init_round_hashgraph,
+    init_simple_hashgraph,
+)
+
+
+def clone_hashgraph(hg):
+    """Fresh hashgraph with the same events re-inserted (events deep-copied
+    via JSON round-trip — insert mutates coordinate metadata in place)."""
+    events = []
+    for p in hg.participants.to_peer_slice():
+        for h in hg.store.participant_events(p.pub_key_hex, -1):
+            events.append(hg.store.get_event(h))
+    events.sort(key=lambda ev: ev.topological_index)
+    fresh = Hashgraph(
+        hg.participants, InmemStore(hg.participants, hg.store.cache_size())
+    )
+    for ev in events:
+        fresh.insert_event(Event.from_json(ev.to_json()), True)
+    return fresh
+
+
+def run_both(hg):
+    """CPU pipeline on one copy, device pipeline on another; returns both."""
+    cpu = clone_hashgraph(hg)
+    dev = clone_hashgraph(hg)
+    cpu_blocks, dev_blocks = [], []
+    cpu.commit_callback = cpu_blocks.append
+    dev.commit_callback = dev_blocks.append
+    cpu.run_consensus()
+    run_consensus_device(dev)
+    return cpu, dev, cpu_blocks, dev_blocks
+
+
+def assert_equivalent(hg):
+    cpu, dev, cpu_blocks, dev_blocks = run_both(hg)
+
+    # per-event analysis results
+    for p in cpu.participants.to_peer_slice():
+        for h in cpu.store.participant_events(p.pub_key_hex, -1):
+            ec = cpu.store.get_event(h)
+            ed = dev.store.get_event(h)
+            assert ec.round == ed.round, f"round mismatch for {h[:16]}"
+            assert ec.lamport_timestamp == ed.lamport_timestamp, (
+                f"lamport mismatch for {h[:16]}"
+            )
+            assert ec.round_received == ed.round_received, (
+                f"round_received mismatch for {h[:16]}: "
+                f"{ec.round_received} vs {ed.round_received}"
+            )
+
+    # round infos: witnesses + fame
+    assert cpu.store.last_round() == dev.store.last_round()
+    for r in range(cpu.store.last_round() + 1):
+        rc = cpu.store.get_round(r)
+        rd = dev.store.get_round(r)
+        assert sorted(rc.witnesses()) == sorted(rd.witnesses()), f"round {r}"
+        for w in rc.witnesses():
+            assert rc.events[w].famous == rd.events[w].famous, (
+                f"fame mismatch round {r} witness {w[:16]}"
+            )
+
+    # consensus order + blocks, byte for byte
+    assert cpu.store.consensus_events() == dev.store.consensus_events()
+    assert len(cpu_blocks) == len(dev_blocks)
+    for bc, bd in zip(cpu_blocks, dev_blocks):
+        assert bc.body.marshal() == bd.body.marshal()
+    assert cpu.undetermined_events == dev.undetermined_events
+
+
+def test_simple_hashgraph_differential():
+    hg, _, _ = init_simple_hashgraph()
+    assert_equivalent(hg)
+
+
+def test_round_hashgraph_differential():
+    hg, _, _ = init_round_hashgraph()
+    assert_equivalent(hg)
+
+
+def test_consensus_hashgraph_differential():
+    hg, _, _ = init_consensus_hashgraph()
+    assert_equivalent(hg)
+
+
+def build_hashgraph_from_grid(grid):
+    """Materialize a synthetic DagGrid as real signed events in a fresh
+    Hashgraph; returns (hashgraph, events-by-row)."""
+    from babble_tpu.crypto import generate_key, pub_key_bytes
+    from babble_tpu.hashgraph import root_self_parent
+    from babble_tpu.peers import Peer, Peers
+
+    keys = [generate_key() for _ in range(grid.n)]
+    participants = Peers()
+    for k in keys:
+        participants.add_peer(
+            Peer(net_addr="", pub_key_hex="0x" + pub_key_bytes(k).hex().upper())
+        )
+    plist = participants.to_peer_slice()
+    # synthetic creator positions index the sorted peer slice
+    sorted_keys = [
+        k
+        for p in plist
+        for k in keys
+        if "0x" + pub_key_bytes(k).hex().upper() == p.pub_key_hex
+    ]
+
+    hg = Hashgraph(participants, InmemStore(participants, 1000))
+    rows = []
+    for i in range(grid.e):
+        c = int(grid.creator[i])
+        sp_row = int(grid.self_parent[i])
+        op_row = int(grid.other_parent[i])
+        sp = rows[sp_row].hex() if sp_row >= 0 else root_self_parent(plist[c].id)
+        op = rows[op_row].hex() if op_row >= 0 else ""
+        ev = Event(
+            transactions=[f"tx{i}".encode()],
+            parents=[sp, op],
+            creator=pub_key_bytes(sorted_keys[c]),
+            index=int(grid.index[i]),
+        )
+        ev.sign(sorted_keys[c])
+        hg.insert_event(ev, True)
+        rows.append(ev)
+    return hg, rows
+
+
+def test_synthetic_grid_matches_host_coordinates():
+    """The synthetic generator's coordinate matrices must match what the
+    host insert path computes for the same DAG."""
+    grid = synthetic_grid(4, 60, seed=7)
+    hg, rows = build_hashgraph_from_grid(grid)
+
+    for i, ev in enumerate(rows):
+        la_host = np.array([x[0] for x in ev.last_ancestors], dtype=np.int64)
+        fd_host = np.array([x[0] for x in ev.first_descendants], dtype=np.int64)
+        assert np.array_equal(la_host, grid.last_ancestors[i]), f"LA row {i}"
+        assert np.array_equal(fd_host, grid.first_descendants[i]), f"FD row {i}"
+
+
+def test_synthetic_dag_differential():
+    """Random gossip DAG: host engine vs device kernels on the same events
+    (coin bits taken from the real event hashes on both sides)."""
+    grid = synthetic_grid(5, 120, seed=13)
+    hg, _ = build_hashgraph_from_grid(grid)
+    assert_equivalent(hg)
+
+
+def test_partial_participation_differential():
+    """A dark validator leaves padding lanes in level 0 of the device grid
+    (regression: duplicate-index scatter must not corrupt row 0)."""
+    from dsl import Play, init_hashgraph_nodes, play_events, create_hashgraph
+    from babble_tpu.hashgraph import root_self_parent
+
+    # 4 participants, only 3 ever create events
+    nodes, index, ordered, participants = init_hashgraph_nodes(4)
+    plist = participants.to_peer_slice()
+    for i in range(3):
+        ev = Event(
+            parents=[root_self_parent(plist[i].id), ""],
+            creator=nodes[i].pub,
+            index=0,
+        )
+        nodes[i].sign_and_add_event(ev, f"e{i}", index, ordered)
+    plays = [
+        Play(0, 1, "e0", "e1", "a0", [b"a0"]),
+        Play(1, 1, "e1", "a0", "a1", [b"a1"]),
+        Play(2, 1, "e2", "a1", "a2", [b"a2"]),
+        Play(0, 2, "a0", "a2", "b0", [b"b0"]),
+        Play(1, 2, "a1", "b0", "b1", [b"b1"]),
+        Play(2, 2, "a2", "b1", "b2", [b"b2"]),
+        Play(0, 3, "b0", "b2", "c0", [b"c0"]),
+        Play(1, 3, "b1", "c0", "c1", [b"c1"]),
+        Play(2, 3, "b2", "c1", "c2", [b"c2"]),
+    ]
+    play_events(plays, nodes, index, ordered)
+    hg = create_hashgraph(ordered, participants)
+    assert_equivalent(hg)
