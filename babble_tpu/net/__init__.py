@@ -8,6 +8,7 @@ from .commands import (
 )
 from .transport import RPC, RPCResponse, Transport, TransportError
 from .inmem_transport import InmemTransport, new_inmem_addr
+from .tcp_transport import TCPTransport
 
 __all__ = [
     "SyncRequest",
@@ -22,4 +23,5 @@ __all__ = [
     "TransportError",
     "InmemTransport",
     "new_inmem_addr",
+    "TCPTransport",
 ]

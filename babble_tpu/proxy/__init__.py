@@ -1,6 +1,9 @@
 from .proxy import AppProxy, ProxyHandler
 from .inmem_proxy import InmemAppProxy
 from .dummy import InmemDummyClient, State
+from .jsonrpc import JSONRPCClient, JSONRPCError, JSONRPCServer
+from .socket_app import SocketAppProxy
+from .socket_babble import DummySocketClient, SocketBabbleProxy
 
 __all__ = [
     "AppProxy",
@@ -8,4 +11,10 @@ __all__ = [
     "InmemAppProxy",
     "InmemDummyClient",
     "State",
+    "JSONRPCClient",
+    "JSONRPCError",
+    "JSONRPCServer",
+    "SocketAppProxy",
+    "SocketBabbleProxy",
+    "DummySocketClient",
 ]

@@ -1,0 +1,160 @@
+"""Minimal JSON-RPC 1.0 over TCP, newline-delimited — the framing used by
+the reference's socket proxies (Go net/rpc/jsonrpc; reference:
+src/proxy/socket/app/socket_app_proxy_client.go:42-99,
+src/proxy/socket/babble/socket_babble_proxy_server.go:71-117).
+
+Request:  {"method": "Service.Method", "params": [arg], "id": n}
+Response: {"id": n, "result": ..., "error": null | "msg"}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.netaddr import split_hostport
+
+
+class JSONRPCError(Exception):
+    pass
+
+
+class JSONRPCClient:
+    """One persistent connection, serialized calls."""
+
+    def __init__(self, addr: str, timeout: float = 5.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        host, port = split_hostport(self.addr)
+        self._sock = socket.create_connection((host, port), timeout=self.timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self._sock.makefile("rb")
+
+    def call(self, method: str, param: Any) -> Any:
+        with self._lock:
+            if self._sock is None:
+                try:
+                    self._connect()
+                except OSError as exc:
+                    self.close_locked()
+                    raise JSONRPCError(
+                        f"connect to {self.addr}: {exc}"
+                    ) from exc
+            self._next_id += 1
+            msg = json.dumps(
+                {"method": method, "params": [param], "id": self._next_id}
+            ).encode() + b"\n"
+            try:
+                self._sock.sendall(msg)
+                line = self._rfile.readline()
+            except (OSError, AttributeError) as exc:
+                self.close_locked()
+                raise JSONRPCError(f"rpc {method} to {self.addr}: {exc}") from exc
+            if not line:
+                self.close_locked()
+                raise JSONRPCError(f"rpc {method}: connection closed")
+            resp = json.loads(line)
+            if resp.get("error"):
+                raise JSONRPCError(str(resp["error"]))
+            return resp.get("result")
+
+    def close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_locked()
+
+
+class JSONRPCServer:
+    """Accept loop dispatching "Service.Method" to registered handlers.
+
+    Handlers take the single decoded param and return a JSON-encodable
+    result; exceptions become the response's error string.
+    """
+
+    def __init__(self, bind_addr: str):
+        host, port = split_hostport(bind_addr)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        lhost, lport = self._listener.getsockname()
+        self.addr = f"{lhost}:{lport}"
+        self._handlers: Dict[str, Callable[[Any], Any]] = {}
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"jsonrpc-{self.addr}", daemon=True
+        )
+
+    def register(self, method: str, handler: Callable[[Any], Any]) -> None:
+        self._handlers[method] = handler
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(sock,), daemon=True
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            rfile = sock.makefile("rb")
+            while not self._shutdown.is_set():
+                line = rfile.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                rid = req.get("id")
+                handler = self._handlers.get(req.get("method", ""))
+                if handler is None:
+                    out = {
+                        "id": rid,
+                        "result": None,
+                        "error": f"unknown method {req.get('method')}",
+                    }
+                else:
+                    params = req.get("params") or [None]
+                    try:
+                        out = {
+                            "id": rid,
+                            "result": handler(params[0]),
+                            "error": None,
+                        }
+                    except Exception as exc:  # noqa: BLE001
+                        out = {"id": rid, "result": None, "error": str(exc)}
+                sock.sendall(json.dumps(out).encode() + b"\n")
+        except (OSError, json.JSONDecodeError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

@@ -1,0 +1,165 @@
+"""Transport tests (reference: src/net/net_transport_test.go:21,158,
+tcp_transport_test.go:10-27, inmem_transport_test.go:7)."""
+
+import threading
+
+import pytest
+
+from babble_tpu.hashgraph.event import WireBody, WireEvent
+from babble_tpu.net import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+    TCPTransport,
+    TransportError,
+)
+
+
+def sample_wire_events():
+    return [
+        WireEvent(
+            body=WireBody(
+                transactions=[b"tx1", b"tx2"],
+                block_signatures=[],
+                self_parent_index=4,
+                other_parent_creator_id=2,
+                other_parent_index=7,
+                creator_id=9,
+                index=5,
+            ),
+            signature="sig",
+        )
+    ]
+
+
+def serve_one(transport, make_response, n=1):
+    """Consume n RPCs off the transport's queue, responding via make_response."""
+
+    def loop():
+        for _ in range(n):
+            rpc = transport.consumer().get(timeout=5)
+            rpc.respond(make_response(rpc.command))
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+def test_tcp_sync_roundtrip():
+    server = TCPTransport("127.0.0.1:0")
+    client = TCPTransport("127.0.0.1:0")
+    try:
+        events = sample_wire_events()
+
+        def respond(cmd):
+            assert isinstance(cmd, SyncRequest)
+            assert cmd.from_id == 0
+            assert cmd.known == {0: 1, 1: 2, 2: 3}
+            return SyncResponse(from_id=1, events=events, known={0: 5, 1: 5, 2: 6})
+
+        serve_one(server, respond)
+        resp = client.sync(
+            server.local_addr(), SyncRequest(from_id=0, known={0: 1, 1: 2, 2: 3})
+        )
+        assert resp.from_id == 1
+        assert len(resp.events) == 1
+        got = resp.events[0]
+        assert got.body.transactions == [b"tx1", b"tx2"]
+        assert got.body.creator_id == 9
+        assert got.signature == "sig"
+        assert resp.known == {0: 5, 1: 5, 2: 6}
+    finally:
+        client.close()
+        server.close()
+
+
+def test_tcp_eager_sync_and_fast_forward():
+    server = TCPTransport("127.0.0.1:0")
+    client = TCPTransport("127.0.0.1:0")
+    try:
+        def respond(cmd):
+            if isinstance(cmd, EagerSyncRequest):
+                return EagerSyncResponse(from_id=1, success=True)
+            assert isinstance(cmd, FastForwardRequest)
+            return FastForwardResponse(from_id=1, snapshot=b"snap")
+
+        serve_one(server, respond, n=2)
+        r1 = client.eager_sync(
+            server.local_addr(),
+            EagerSyncRequest(from_id=0, events=sample_wire_events()),
+        )
+        assert r1.success
+        r2 = client.fast_forward(
+            server.local_addr(), FastForwardRequest(from_id=0)
+        )
+        assert r2.snapshot == b"snap"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_tcp_pooled_connections():
+    """Concurrent RPCs from one client reuse/pool conns
+    (reference: net_transport_test.go:158 TestNetworkTransport_PooledConn)."""
+    server = TCPTransport("127.0.0.1:0", max_pool=3)
+    client = TCPTransport("127.0.0.1:0", max_pool=3)
+    try:
+        n = 20
+
+        def respond(cmd):
+            return SyncResponse(from_id=1, known=dict(cmd.known))
+
+        serve_one(server, respond, n=n)
+        errs = []
+
+        def worker(i):
+            try:
+                resp = client.sync(
+                    server.local_addr(), SyncRequest(from_id=0, known={0: i})
+                )
+                assert resp.known == {0: i}
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+    finally:
+        client.close()
+        server.close()
+
+
+def test_tcp_error_response():
+    server = TCPTransport("127.0.0.1:0")
+    client = TCPTransport("127.0.0.1:0")
+    try:
+        def loop():
+            rpc = server.consumer().get(timeout=5)
+            rpc.respond(None, error="boom")
+
+        threading.Thread(target=loop, daemon=True).start()
+        with pytest.raises(TransportError, match="boom"):
+            client.sync(server.local_addr(), SyncRequest(from_id=0, known={}))
+    finally:
+        client.close()
+        server.close()
+
+
+def test_tcp_bad_advertise_rejected():
+    with pytest.raises(TransportError):
+        TCPTransport("127.0.0.1:0", advertise="0.0.0.0:1337")
+
+
+def test_tcp_dial_refused():
+    client = TCPTransport("127.0.0.1:0")
+    try:
+        with pytest.raises(TransportError):
+            client.sync("127.0.0.1:1", SyncRequest(from_id=0, known={}))
+    finally:
+        client.close()

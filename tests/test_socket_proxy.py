@@ -1,0 +1,87 @@
+"""Socket proxy tests (reference: src/proxy/socket_proxy_test.go:56,99) —
+both ends of the TCP JSON-RPC split exercised against the dummy State."""
+
+import time
+
+import pytest
+
+from babble_tpu.crypto import simple_hash_from_two_hashes
+from babble_tpu.hashgraph import Block
+from babble_tpu.proxy import (
+    DummySocketClient,
+    JSONRPCError,
+    SocketAppProxy,
+    SocketBabbleProxy,
+    State,
+)
+
+
+def make_pair():
+    """Wire a node-side SocketAppProxy to an app-side SocketBabbleProxy.
+
+    Both listen on ephemeral ports; the app dials the node's submit server
+    and the node dials the app's state server.
+    """
+    state = State()
+    app = SocketBabbleProxy("0:0", "127.0.0.1:0", state)  # node addr set later
+    node = SocketAppProxy(app.bind_addr, "127.0.0.1:0")
+    app.client.addr = node.bind_addr
+    return node, app, state
+
+
+def test_submit_tx_reaches_node_submit_ch():
+    node, app, _ = make_pair()
+    try:
+        app.submit_tx(b"the test transaction")
+        got = node.submit_ch().get(timeout=3)
+        assert got == b"the test transaction"
+    finally:
+        node.close()
+        app.close()
+
+
+def test_commit_block_roundtrip():
+    node, app, state = make_pair()
+    try:
+        block = Block(index=0, round_received=1, transactions=[b"tx 1", b"tx 2"])
+        returned = node.commit_block(block)
+        expected = simple_hash_from_two_hashes(b"", b"tx 1")
+        expected = simple_hash_from_two_hashes(expected, b"tx 2")
+        assert returned == expected
+        assert state.get_committed_transactions() == [b"tx 1", b"tx 2"]
+    finally:
+        node.close()
+        app.close()
+
+
+def test_snapshot_and_restore():
+    node, app, state = make_pair()
+    try:
+        block = Block(index=5, round_received=1, transactions=[b"a"])
+        h = node.commit_block(block)
+        assert node.get_snapshot(5) == h
+        with pytest.raises(JSONRPCError):
+            node.get_snapshot(99)
+        restored = node.restore(b"\x01\x02")
+        assert restored == b"\x01\x02"
+        assert state.state_hash == b"\x01\x02"
+    finally:
+        node.close()
+        app.close()
+
+
+def test_dummy_socket_client():
+    node = SocketAppProxy("127.0.0.1:1", "127.0.0.1:0")
+    try:
+        dummy = DummySocketClient(node.bind_addr, "127.0.0.1:0")
+        node.client.addr = dummy.proxy.bind_addr
+        try:
+            dummy.submit_tx(b"hello")
+            assert node.submit_ch().get(timeout=3) == b"hello"
+            node.commit_block(Block(index=0, round_received=1, transactions=[b"hello"]))
+            time.sleep(0.05)
+            assert dummy.state.get_committed_transactions() == [b"hello"]
+        finally:
+            dummy.close()
+    finally:
+        node.close()
