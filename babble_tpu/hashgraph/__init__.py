@@ -2,6 +2,7 @@ from .event import Event, EventBody, WireEvent, WireBody, root_self_parent, by_l
 from .root import Root, RootEvent, new_base_root, new_base_root_event
 from .round_info import RoundInfo, RoundEvent, Trilean, PendingRound
 from .frame import Frame
+from .section import FrozenRef, Section
 from .block import Block, BlockBody, BlockSignature, WireBlockSignature, new_block_from_frame
 from .store import Store
 from .inmem_store import InmemStore
@@ -25,6 +26,8 @@ __all__ = [
     "Trilean",
     "PendingRound",
     "Frame",
+    "FrozenRef",
+    "Section",
     "Block",
     "BlockBody",
     "BlockSignature",

@@ -33,6 +33,7 @@ from .event import Event, WireEvent, root_self_parent
 from .frame import Frame
 from .root import Root, RootEvent
 from .round_info import PendingRound, RoundInfo
+from .section import FrozenRef, Section
 from .store import Store
 
 MAX_INT32 = 2**31 - 1
@@ -88,6 +89,13 @@ class Hashgraph:
         # memo caches (unbounded dicts; cleared on Reset)
         self._round_cache: Dict[str, int] = {}
         self._timestamp_cache: Dict[str, int] = {}
+
+        # identities of events below a fast-sync section cut, referenced as
+        # other-parents by section events (see section.py); reset_floor is
+        # the anchor round of the last applied section — rounds at or below
+        # it are undecidable here and skipped in the round-received scan
+        self.frozen_refs: Dict[str, FrozenRef] = {}
+        self.reset_floor: Optional[int] = None
 
     # ------------------------------------------------------------------
     # positions
@@ -301,6 +309,8 @@ class Hashgraph:
             self.store.get_event(other_parent)
             return
         except StoreErr:
+            if other_parent in self.frozen_refs:
+                return
             root = self.store.get_root(event.creator())
             other = root.others.get(event.hex())
             if other is not None and other.hash == other_parent:
@@ -432,7 +442,19 @@ class Hashgraph:
         other = root.others.get(ev.hex())
         if other is not None and other.hash == op:
             return other
-        other_parent = self.store.get_event(op)
+        try:
+            other_parent = self.store.get_event(op)
+        except StoreErr:
+            ref = self.frozen_refs.get(op)
+            if ref is None:
+                raise
+            return RootEvent(
+                hash=op,
+                creator_id=ref.creator_id,
+                index=ref.index,
+                lamport_timestamp=ref.lamport,
+                round=ref.round,
+            )
         return RootEvent(
             hash=op,
             creator_id=self.participants.by_pub_key[other_parent.creator()].id,
@@ -562,6 +584,11 @@ class Hashgraph:
                 try:
                     tr = self.store.get_round(i)
                 except StoreErr:
+                    # rounds at or below a fast-sync cut are undecidable
+                    # here; the donor already evaluated them as not
+                    # receiving this event, so keep scanning upward
+                    if self.reset_floor is not None and i <= self.reset_floor:
+                        continue
                     # can happen after Reset/fast-sync
                     if (
                         self.last_consensus_round is not None
@@ -751,6 +778,8 @@ class Hashgraph:
 
         self._round_cache.clear()
         self._timestamp_cache.clear()
+        self.frozen_refs.clear()
+        self.reset_floor = None
 
         participants = self.participants.to_peer_slice()
         root_map = {participants[pos].pub_key_hex: root for pos, root in enumerate(frame.roots)}
@@ -760,6 +789,164 @@ class Hashgraph:
 
         for ev in frame.events:
             self.insert_event(ev, False)
+
+    # ------------------------------------------------------------------
+    # fast-sync live section (beyond the reference — see section.py)
+    # ------------------------------------------------------------------
+
+    def get_section(self, anchor_round: int) -> Section:
+        """Donor side: everything decided or pending above the anchor cut.
+        Caller must hold the node's core lock so the snapshot is consistent."""
+        last_consensus = (
+            self.last_consensus_round
+            if self.last_consensus_round is not None
+            else anchor_round
+        )
+
+        # Per-column collection: every event above the joiner's post-reset
+        # base head (its frame head, or the frame root's self-parent for
+        # columns absent from the frame). This is exactly the diff a fresh
+        # reset store would request, so self-parent chains stay intact.
+        frame = self.get_frame(anchor_round)
+        peer_slice = self.participants.to_peer_slice()
+        base_idx: Dict[str, int] = {
+            peer.pub_key_hex: frame.roots[i].self_parent.index
+            for i, peer in enumerate(peer_slice)
+        }
+        for ev in frame.events:
+            p = ev.creator()
+            if ev.index() > base_idx[p]:
+                base_idx[p] = ev.index()
+
+        events: List[Event] = []
+        seen = set()
+        for p, base in base_idx.items():
+            for h in self.store.participant_events(p, base):
+                ev = self.store.get_event(h)
+                if ev.round is None:
+                    ev.set_round(self.round(h))
+                if ev.lamport_timestamp is None:
+                    ev.set_lamport_timestamp(self.lamport_timestamp(h))
+                events.append(ev)
+                seen.add(h)
+        events.sort(key=lambda e: e.topological_index)
+
+        rounds: Dict[int, RoundInfo] = {}
+        for r in range(anchor_round + 1, self.store.last_round() + 1):
+            try:
+                rounds[r] = self.store.get_round(r)
+            except StoreErr:
+                continue
+
+        # refs for other-parents below the cut (frame events of the anchor
+        # round are shipped separately and are not "frozen")
+        frame_hashes = {e.hex() for e in frame.events}
+        frozen: List[FrozenRef] = []
+        frozen_seen = set()
+        for ev in events:
+            op = ev.other_parent()
+            if (
+                op != ""
+                and op not in seen
+                and op not in frame_hashes
+                and op not in frozen_seen
+            ):
+                try:
+                    ope = self.store.get_event(op)
+                except StoreErr:
+                    continue  # donor itself only has a ref — skip
+                frozen_seen.add(op)
+                frozen.append(
+                    FrozenRef(
+                        hash=op,
+                        creator_id=self.participants.by_pub_key[ope.creator()].id,
+                        index=ope.index(),
+                        round=self.round(op),
+                        lamport=self.lamport_timestamp(op),
+                    )
+                )
+
+        frames = [
+            self.get_frame(r) for r in range(anchor_round + 1, last_consensus + 1)
+        ]
+        base_meta = [
+            FrozenRef(
+                hash=ev.hex(),
+                creator_id=self.participants.by_pub_key[ev.creator()].id,
+                index=ev.index(),
+                round=self.round(ev.hex()),
+                lamport=self.lamport_timestamp(ev.hex()),
+            )
+            for ev in frame.events
+        ]
+        return Section(
+            anchor_round=anchor_round,
+            last_consensus_round=last_consensus,
+            events=events,
+            rounds=rounds,
+            frames=frames,
+            frozen_refs=frozen,
+            base_meta=base_meta,
+        )
+
+    def apply_section(self, section: Section) -> None:
+        """Joiner side: replay the donor's decided state above the anchor.
+        Must run right after reset(block, frame); run_consensus() afterwards
+        rebuilds the donor's blocks byte-identically via the shipped frames
+        and then continues live from the donor's frontier."""
+        # the frame base is settled by definition (anchored in the block);
+        # it must never be re-received into a later round
+        for h in self.undetermined_events:
+            ev = self.store.get_event(h)
+            ev.set_round_received(section.anchor_round)
+            self.store.set_event(ev)
+        self.undetermined_events = []
+        self.reset_floor = section.anchor_round
+
+        self.frozen_refs.update({fr.hash: fr for fr in section.frozen_refs})
+        # pin the anchor frame events' consensus metadata so nothing here
+        # recomputes it from the amnesiac base
+        for fr in section.base_meta:
+            self._round_cache[fr.hash] = fr.round
+            self._timestamp_cache[fr.hash] = fr.lamport
+            try:
+                ev = self.store.get_event(fr.hash)
+            except StoreErr:
+                continue
+            ev.set_round(fr.round)
+            ev.set_lamport_timestamp(fr.lamport)
+            self.store.set_event(ev)
+        for f in section.frames:
+            self.store.set_frame(f)
+        for r in sorted(section.rounds):
+            ri = section.rounds[r]
+            ri.queued = True  # pending status is tracked below
+            self.store.set_round(r, ri)
+
+        for ev in section.events:
+            if not ev.verify():
+                raise ValueError("Invalid Event signature in fast-sync section")
+            self._check_self_parent(ev)
+            self._check_other_parent(ev)
+            ev.topological_index = self.topological_index
+            self.topological_index += 1
+            # authoritative donor metadata — not recomputed
+            self._round_cache[ev.hex()] = ev.round
+            self._timestamp_cache[ev.hex()] = ev.lamport_timestamp
+            self.store.set_event(ev)
+            if ev.round_received is None:
+                self.undetermined_events.append(ev.hex())
+                if ev.is_loaded():
+                    self.pending_loaded_events += 1
+            elif ev.round_received > section.anchor_round and ev.is_loaded():
+                # decremented again when its round is replayed into a block
+                self.pending_loaded_events += 1
+            self.sig_pool.extend(ev.block_signatures())
+
+        self.pending_rounds = [
+            PendingRound(r, section.rounds[r].witnesses_decided())
+            for r in sorted(section.rounds)
+        ]
 
     def bootstrap(self) -> None:
         """Replay a persistent store's topologically-ordered events through

@@ -182,6 +182,13 @@ class InmemStore(Store):
         self.participant_events_cache.reset()
         self._last_round = -1
         self._last_block = -1
+        # Beyond the reference (which keeps these, inmem_store.go:272-282):
+        # frames and last-consensus-event entries built on the pre-reset
+        # timeline would leak into future frame roots and diverge them;
+        # after a reset the fast-sync section re-seeds both. Blocks are
+        # chain history and survive.
+        self.frame_cache = LRU(self._cache_size)
+        self.last_consensus_events = {}
 
     def close(self) -> None:
         pass

@@ -9,7 +9,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ..hashgraph import Block, Frame, WireEvent
+from ..hashgraph import Block, Frame, Section, WireEvent
 
 
 @dataclass
@@ -85,13 +85,17 @@ class EagerSyncResponse:
 @dataclass
 class FastForwardRequest:
     from_id: int
+    # last block the requester holds: the responder ships its own blocks
+    # for the gap (low_block, anchor) so blocks the requester committed
+    # mid-catch-up (on a pre-reset timeline) are reconciled with the chain
+    low_block: int = -1
 
     def to_json(self) -> dict:
-        return {"FromID": self.from_id}
+        return {"FromID": self.from_id, "LowBlock": self.low_block}
 
     @classmethod
     def from_json(cls, d: dict) -> "FastForwardRequest":
-        return cls(from_id=d["FromID"])
+        return cls(from_id=d["FromID"], low_block=d.get("LowBlock", -1))
 
 
 @dataclass
@@ -100,6 +104,8 @@ class FastForwardResponse:
     block: Optional[Block] = None
     frame: Optional[Frame] = None
     snapshot: bytes = b""
+    section: Optional[Section] = None
+    gap_blocks: List[Block] = field(default_factory=list)
 
     def to_json(self) -> dict:
         from ..utils.codec import b64e
@@ -109,6 +115,8 @@ class FastForwardResponse:
             "Block": self.block.to_json() if self.block is not None else None,
             "Frame": self.frame.to_json() if self.frame is not None else None,
             "Snapshot": b64e(self.snapshot),
+            "Section": self.section.to_json() if self.section is not None else None,
+            "GapBlocks": [b.to_json() for b in self.gap_blocks],
         }
 
     @classmethod
@@ -120,4 +128,6 @@ class FastForwardResponse:
             block=Block.from_json(d["Block"]) if d.get("Block") else None,
             frame=Frame.from_json(d["Frame"]) if d.get("Frame") else None,
             snapshot=b64d(d.get("Snapshot", "")),
+            section=Section.from_json(d["Section"]) if d.get("Section") else None,
+            gap_blocks=[Block.from_json(b) for b in d.get("GapBlocks", [])],
         )

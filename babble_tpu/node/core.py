@@ -136,11 +136,30 @@ class Core:
                 other_head = ev.hex()
         self.add_self_event(other_head)
 
-    def fast_forward(self, peer: str, block: Block, frame: Frame) -> None:
+    def fast_forward(
+        self, peer: str, block: Block, frame: Frame, section=None
+    ) -> None:
+        # Deep-copy through the wire codec: over the in-process transport the
+        # block/frame/section share mutable state with the responder's store,
+        # and the frame events carry the responder's cached round/lamport/
+        # coordinate metadata — it must be stripped so Reset recomputes it
+        # against the new roots (the Go reference gets this for free from
+        # value+codec semantics at the RPC boundary; with live objects, stale
+        # ev.round makes DivideRounds skip witness registration and consensus
+        # stalls). The section's metadata, by contrast, is deliberately
+        # carried in its wire form (see hashgraph/section.py).
+        from ..hashgraph import Section
+
+        block = Block.from_json(block.to_json())
+        frame = Frame.from_json(frame.to_json())
+        if section is not None:
+            section = Section.from_json(section.to_json())
         self.hg.check_block(block)
         if block.frame_hash() != frame.hash():
             raise ValueError("Invalid Frame Hash")
         self.hg.reset(block, frame)
+        if section is not None:
+            self.hg.apply_section(section)
         self.set_head_and_seq()
         self.run_consensus()
 

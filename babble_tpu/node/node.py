@@ -245,9 +245,12 @@ class Node(NodeStateMachine):
         resp_err: Optional[str] = None
         try:
             with self.core_lock:
+                # anchor + live section must come from one consistent snapshot
                 block, frame = self.core.get_anchor_block_with_frame()
+                section = self.core.hg.get_section(frame.round)
             resp.block = block
             resp.frame = frame
+            resp.section = section
             resp.snapshot = self.proxy.get_snapshot(block.index())
         except Exception as e:
             self.logger.error("FastForwardRequest: %s", e)
@@ -320,9 +323,14 @@ class Node(NodeStateMachine):
             resp = self.trans.fast_forward(
                 peer.net_addr, FastForwardRequest(from_id=self.id)
             )
-            with self.core_lock:
-                self.core.fast_forward(peer.pub_key_hex, resp.block, resp.frame)
+            # restore the app BEFORE core.fast_forward: applying the section
+            # replays blocks above the anchor through the commit channel, and
+            # those commits must land on the restored snapshot state
             self.proxy.restore(resp.snapshot)
+            with self.core_lock:
+                self.core.fast_forward(
+                    peer.pub_key_hex, resp.block, resp.frame, resp.section
+                )
         except Exception as e:
             self.logger.error("fast_forward: %s", e)
             time.sleep(self.conf.heartbeat_timeout)

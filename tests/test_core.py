@@ -197,3 +197,58 @@ def test_core_fast_forward():
     lagging.fast_forward(cores[0].hex_id(), block, frame)
     assert lagging.get_last_block_index() == 0
     assert lagging.hg.last_consensus_round == block.round_received()
+
+
+def test_core_fast_forward_then_keep_syncing():
+    """Regression: consensus must keep advancing on a core that joined
+    mid-history via fast-forward. Over the in-process transport, frame
+    events arrive as live objects whose cached round/coordinate metadata
+    (and shared mutable state) must be stripped at the fast-forward
+    boundary, or DivideRounds skips witness registration and the joiner
+    stalls forever (reference gets this from Go value+codec semantics)."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 2:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 600, "3-core playbook failed to make blocks"
+
+    blk = cores[0].hg.store.get_block(1)
+    for c in cores[:3]:
+        blk.set_signature(blk.sign(c.key))
+    cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+
+    section = cores[0].hg.get_section(frame.round)
+
+    lagging = Core(
+        3, cores[3].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    lagging.fast_forward(cores[0].hex_id(), block, frame, section)
+    # the live section replays the donor's blocks above the anchor
+    joined_at = lagging.get_last_block_index()
+    assert joined_at == cores[0].get_last_block_index()
+    for bi in range(block.index() + 1, joined_at + 1):
+        assert (
+            cores[0].hg.store.get_block(bi).body.marshal()
+            == lagging.hg.store.get_block(bi).body.marshal()
+        ), f"replayed block {bi} differs from donor"
+
+    cores[3] = lagging
+    for j in range(120):
+        a, b = j % 4, (j + 1) % 4
+        sync_and_run_consensus(cores, a, b, [f"post{j}".encode()])
+
+    assert lagging.get_last_block_index() > joined_at + 5, (
+        "joiner stalled after fast-forward"
+    )
+    # every block the joiner produced must be byte-identical to core0's
+    hi = min(cores[0].get_last_block_index(), lagging.get_last_block_index())
+    for bi in range(joined_at + 1, hi + 1):
+        assert (
+            cores[0].hg.store.get_block(bi).body.marshal()
+            == lagging.hg.store.get_block(bi).body.marshal()
+        )

@@ -1,0 +1,252 @@
+"""Fast-sync / catch-up / bootstrap integration tests
+(reference: src/node/node_test.go:455,497,533,583,660)."""
+
+import copy
+import os
+import time
+
+from babble_tpu.crypto import generate_key, pub_key_bytes
+from babble_tpu.hashgraph import InmemStore, SQLiteStore
+from babble_tpu.net import InmemTransport, SyncRequest
+from babble_tpu.node import Config, Node
+from babble_tpu.node.state import NodeState
+from babble_tpu.peers import Peer, Peers
+from babble_tpu.proxy import InmemDummyClient
+
+from test_node import (
+    bombard_and_wait,
+    check_gossip,
+    run_nodes,
+    shutdown_nodes,
+)
+
+
+def make_config(sync_limit=150):
+    """sync_limit must be high enough that healthy nodes never spuriously
+    flip to CatchingUp (that halts consensus: fewer than a supermajority of
+    active event creators remain); only a genuinely-behind joiner should
+    exceed it. The reference tests use large limits for the same reason
+    (node_test.go:533-541)."""
+    return Config(
+        heartbeat_timeout=0.005, tcp_timeout=1.0, cache_size=1000,
+        sync_limit=sync_limit,
+    )
+
+
+def build_cluster(n, conf, store_factory=None):
+    """Like test_node.init_nodes but keeps keys so nodes can be recycled
+    (reference: node_test.go:292-388)."""
+    keys = [generate_key() for _ in range(n)]
+    participants = Peers()
+    peer_list = []
+    for i, key in enumerate(keys):
+        pub_hex = "0x" + pub_key_bytes(key).hex().upper()
+        peer = Peer(net_addr=f"127.0.0.1:{9990 + i}", pub_key_hex=pub_hex)
+        participants.add_peer(peer)
+        peer_list.append(peer)
+
+    # RPC timeout balances two pressures: fast-forward responses wait on
+    # core_lock while the serving node is mid-consensus (needs headroom),
+    # while gossip to a dark peer burns a gossip thread for the full
+    # timeout (needs a cap)
+    transports = [InmemTransport(p.net_addr, timeout=5.0) for p in peer_list]
+    for t in transports:
+        for u in transports:
+            if t is not u:
+                t.connect(u.local_addr(), u)
+
+    nodes, proxies = [], []
+    for i, key in enumerate(keys):
+        store = (
+            store_factory(i, participants, conf)
+            if store_factory
+            else InmemStore(participants, conf.cache_size)
+        )
+        prox = InmemDummyClient()
+        node = Node(
+            copy.copy(conf), peer_list[i].id, key, participants, store,
+            transports[i], prox,
+        )
+        node.init()
+        nodes.append(node)
+        proxies.append(prox)
+    return nodes, proxies, keys, peer_list, participants, transports
+
+
+def first_available_block(node, upto):
+    """A fast-forwarded node starts mid-history; find the first block it
+    actually holds."""
+    for i in range(upto + 1):
+        try:
+            node.get_block(i)
+            return i
+        except Exception:  # noqa: BLE001
+            continue
+    raise AssertionError("node holds no blocks at all")
+
+
+def connect_transport(transports, new_trans):
+    for t in transports:
+        t.connect(new_trans.local_addr(), new_trans)
+        new_trans.connect(t.local_addr(), t)
+
+
+def test_sync_limit_response():
+    """A peer far behind must get SyncLimit=true instead of a huge diff
+    (reference: node_test.go:455-496)."""
+    conf = make_config()
+    nodes, proxies, *_ = build_cluster(4, conf)
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=1)
+        # drop the responder's limit only now (a healthy run-time limit
+        # this low would halt consensus), then claim to know nothing
+        nodes[1].conf.sync_limit = 10
+        node0 = nodes[0]
+        empty_known = {p_id: -1 for p_id in node0.core.known_events()}
+        resp = node0.trans.sync(
+            nodes[1].local_addr,
+            SyncRequest(from_id=node0.id, known=empty_known),
+        )
+        assert resp.sync_limit is True
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_catch_up():
+    """Start 3 of 4 nodes, run ahead beyond sync-limit, then start the 4th:
+    it must flip to CatchingUp, fast-forward from a peer's anchor block and
+    rejoin consensus (reference: node_test.go:533-582)."""
+    conf = make_config()
+    nodes, proxies, *_ = build_cluster(4, conf)
+    node4, prox4 = nodes[3], proxies[3]
+    nodes3, proxies3 = nodes[:3], proxies[:3]
+    try:
+        run_nodes(nodes3)
+        # run until the joiner would be beyond the sync limit
+        target = 3
+        while True:
+            bombard_and_wait(nodes3, proxies3, target_block=target, timeout_s=90)
+            total_events = sum(
+                i + 1 for i in nodes3[0].core.known_events().values()
+            )
+            if total_events > conf.sync_limit + 50:
+                break
+            target += 1
+        target = min(n.core.get_last_block_index() for n in nodes3)
+
+        node4.run_async(True)
+        bombard_and_wait(nodes, proxies, target_block=target + 2, timeout_s=60)
+        # node4 joined mid-history: its first block came from a frame,
+        # and from there on bodies must be byte-identical
+        start = first_available_block(node4, target + 2)
+        check_gossip(nodes, from_block=start, upto=target + 2)
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_fast_sync_repeated():
+    """Kill and restart a node twice; it must catch up each time
+    (reference: node_test.go:583-642)."""
+    conf = make_config()
+    nodes, proxies, keys, peer_list, participants, transports = build_cluster(4, conf)
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+
+        for _round in range(2):
+            victim = nodes[3]
+            victim.shutdown()
+            transports[3].disconnect_all()
+            for t in transports[:3]:
+                t.disconnect(transports[3].local_addr())
+
+            # run the survivors far enough ahead that the recycled node's
+            # empty store is beyond the sync limit
+            base = max(n.core.get_last_block_index() for n in nodes[:3])
+            goal_ahead = base + 3
+            while True:
+                bombard_and_wait(
+                    nodes[:3], proxies[:3], target_block=goal_ahead, timeout_s=90
+                )
+                total_events = sum(
+                    i + 1 for i in nodes[0].core.known_events().values()
+                )
+                if total_events > conf.sync_limit + 50:
+                    break
+                goal_ahead += 1
+            base = goal_ahead
+
+            # recycle: fresh store + transport, same key (node_test.go:357-388)
+            trans = InmemTransport(peer_list[3].net_addr, timeout=5.0)
+            connect_transport(transports[:3], trans)
+            transports[3] = trans
+            prox = InmemDummyClient()
+            store = InmemStore(participants, conf.cache_size)
+            node = Node(
+                conf, peer_list[3].id, keys[3], participants, store, trans, prox
+            )
+            node.init()
+            nodes[3] = node
+            proxies[3] = prox
+            node.run_async(True)
+
+            goal = base + 5
+            bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=60)
+            start = first_available_block(node, goal)
+            check_gossip(nodes, from_block=start, upto=goal)
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_bootstrap_all_nodes(tmp_path):
+    """Run a sqlite-backed cluster, stop it, then rebuild every node from its
+    database replay and keep going (reference: node_test.go:660-729)."""
+    conf = make_config()
+
+    def store_factory(i, participants, conf):
+        return SQLiteStore.load_or_create(
+            participants, conf.cache_size, os.path.join(tmp_path, f"node{i}.db")
+        )
+
+    nodes, proxies, keys, peer_list, participants, transports = build_cluster(
+        4, conf, store_factory=store_factory
+    )
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+        check_gossip(nodes, upto=2)
+        base = min(n.core.get_last_block_index() for n in nodes)
+        shutdown_nodes(nodes)
+        for s in [n.core.hg.store for n in nodes]:
+            s.close()
+        time.sleep(0.1)
+
+        # rebuild everything from disk
+        transports = [InmemTransport(p.net_addr) for p in peer_list]
+        for t in transports:
+            for u in transports:
+                if t is not u:
+                    t.connect(u.local_addr(), u)
+        nodes2, proxies2 = [], []
+        for i, key in enumerate(keys):
+            store = store_factory(i, participants, conf)
+            assert store.need_bootstrap(), f"node {i} store should need bootstrap"
+            prox = InmemDummyClient()
+            node = Node(
+                conf, peer_list[i].id, key, participants, store,
+                transports[i], prox,
+            )
+            node.init()
+            assert node.core.get_last_block_index() >= 0, (
+                "bootstrap lost the committed blocks"
+            )
+            nodes2.append(node)
+            proxies2.append(prox)
+
+        run_nodes(nodes2)
+        bombard_and_wait(nodes2, proxies2, target_block=base + 2, timeout_s=60)
+        check_gossip(nodes2, upto=base + 2)
+        nodes = nodes2  # for the finally clause
+    finally:
+        shutdown_nodes(nodes)
