@@ -36,84 +36,48 @@ class PassResults:
     last_round: int
 
 
-def _bucket(n: int, minimum: int = 8) -> int:
-    """Round up to a power of two to amortize recompilation across batch
-    sizes (XLA wants static shapes)."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
-
-
 def run_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
-    """Run DivideRounds + DecideFame + DecideRoundReceived on device."""
-    import jax.numpy as jnp
+    """Run DivideRounds + DecideFame + DecideRoundReceived as one fused
+    XLA program — no host synchronization between passes (last_round is
+    computed on device; the fame loop early-exits on device)."""
+    import jax
 
     r_max = grid.r_max
+    # the fame offset loop is self-bounding (j <= last_round < r_max);
+    # d_cap is a static safety net only, so it never triggers recompiles
+    d_cap = d_max if d_max is not None else r_max + 2
 
-    # upload the shared inputs once; the coordinate matrices are the large
-    # buffers (E x N int32) consumed by all three kernels
-    la = jnp.asarray(grid.last_ancestors)
-    fd = jnp.asarray(grid.first_descendants)
-    index = jnp.asarray(grid.index)
-    creator = jnp.asarray(grid.creator)
-
-    dr = kernels.divide_rounds(
-        jnp.asarray(grid.levels),
-        creator,
-        index,
-        jnp.asarray(grid.self_parent),
-        jnp.asarray(grid.other_parent),
-        la,
-        fd,
-        jnp.asarray(grid.root_next_round),
-        jnp.asarray(grid.root_sp_round),
-        jnp.asarray(grid.root_sp_lamport),
-        grid.super_majority,
-        r_max,
-    )
-    rounds_np = np.asarray(dr.rounds)
-    last_round = int(rounds_np.max(initial=-1))
-
-    # offsets must span to the last round for bit-exactness with the
-    # reference's j-loop (reference: hashgraph.go:868-931); bucketed so the
-    # kernel is reused across growing DAGs
-    span = d_max if d_max is not None else _bucket(max(last_round, 1))
-
-    fame = kernels.decide_fame(
-        dr.witness_table,
-        la,
-        fd,
-        index,
-        jnp.asarray(grid.coin_bit),
-        jnp.int32(last_round),
+    res = kernels.consensus_pipeline(
+        grid.levels,
+        grid.creator,
+        grid.index,
+        grid.self_parent,
+        grid.other_parent,
+        grid.last_ancestors,
+        grid.first_descendants,
+        grid.ext_sp_round,
+        grid.ext_op_round,
+        grid.fixed_round,
+        grid.ext_sp_lamport,
+        grid.ext_op_lamport,
+        grid.coin_bit,
         grid.super_majority,
         grid.n,
-        span,
+        r_max,
+        d_cap,
     )
-
-    received = kernels.decide_round_received(
-        dr.witness_table,
-        la,
-        index,
-        creator,
-        dr.rounds,
-        fame.decided,
-        fame.famous,
-        fame.rounds_decided,
-        jnp.int32(last_round),
-    )
+    host = jax.device_get(res)  # one batched transfer
 
     return PassResults(
-        rounds=rounds_np,
-        witness=np.asarray(dr.witness),
-        lamport=np.asarray(dr.lamport),
-        witness_table=np.asarray(dr.witness_table),
-        fame_decided=np.asarray(fame.decided),
-        famous=np.asarray(fame.famous),
-        rounds_decided=np.asarray(fame.rounds_decided),
-        received=np.asarray(received),
-        last_round=last_round,
+        rounds=host.rounds,
+        witness=host.witness,
+        lamport=host.lamport,
+        witness_table=host.witness_table,
+        fame_decided=host.fame_decided,
+        famous=host.famous,
+        rounds_decided=host.rounds_decided,
+        received=host.received,
+        last_round=int(host.last_round),
     )
 
 

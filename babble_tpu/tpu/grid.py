@@ -15,6 +15,15 @@ at most one event per level (the self-parent sits one level down), so each
 level holds <= N events and the whole DAG processes as a scan over levels
 with all within-level work vectorized — the TPU-native replacement for the
 reference's per-event recursion.
+
+Parents that live *outside* the grid (root self-parents, root `others`
+entries created by fast-sync Reset — reference: src/hashgraph/root.go:92-96
+— or already-determined events outside an incremental window) are resolved
+host-side into per-event external metadata (`ext_sp_round`, `ext_op_round`,
+`fixed_round`, lamport equivalents), mirroring the root cases of the
+reference round/lamport recursion (reference: src/hashgraph/
+hashgraph.go:205-278,325-379). This makes the device path valid on any
+hashgraph state, including after Reset/fast-sync.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 MAX_INT32 = 2**31 - 1
+MIN_INT32 = -(2**31)
 
 
 @dataclass
@@ -36,52 +46,62 @@ class DagGrid:
     super_majority: int
     creator: np.ndarray  # (E,) int32 peer position
     index: np.ndarray  # (E,) int32 per-creator sequence number
-    self_parent: np.ndarray  # (E,) int32 event row, -1 = attached to root
-    other_parent: np.ndarray  # (E,) int32 event row, -1 = none
+    self_parent: np.ndarray  # (E,) int32 event row, -1 = outside grid
+    other_parent: np.ndarray  # (E,) int32 event row, -1 = none/outside grid
     last_ancestors: np.ndarray  # (E, N) int32
     first_descendants: np.ndarray  # (E, N) int32 (MAX_INT32 = none)
     coin_bit: np.ndarray  # (E,) bool
-    root_next_round: np.ndarray  # (N,) int32
-    root_sp_round: np.ndarray  # (N,) int32
-    root_sp_lamport: np.ndarray  # (N,) int32
+    # external-parent metadata (used where the parent row is -1):
+    fixed_round: np.ndarray  # (E,) int32: >=0 forces the round (root-attached)
+    ext_sp_round: np.ndarray  # (E,) int32 self-parent round outside grid
+    ext_op_round: np.ndarray  # (E,) int32 other-parent round outside grid (-1 none)
+    ext_sp_lamport: np.ndarray  # (E,) int32
+    ext_op_lamport: np.ndarray  # (E,) int32 (MIN_INT32 = none)
     levels: np.ndarray  # (L, N) int32 event rows, -1 padding
     num_levels: int
     hashes: Optional[List[str]] = None  # row -> event hex (host bookkeeping)
 
     @property
+    def r_base(self) -> int:
+        """Highest externally-supplied round — the starting point of any
+        round numbering inside the grid."""
+        base = 0
+        if self.e:
+            base = max(
+                base,
+                int(self.fixed_round.max(initial=0)),
+                int(self.ext_sp_round.max(initial=0)),
+                int(self.ext_op_round.max(initial=0)),
+            )
+        return base
+
+    @property
     def r_max(self) -> int:
-        # round(e) <= level(e) + max root next_round (see module docstring)
-        return self.num_levels + int(self.root_next_round.max(initial=0)) + 2
+        # round(e) <= level(e) + r_base + 1 (a round advance needs at least
+        # one new level); +2 margin for the fame lookahead
+        return self.num_levels + self.r_base + 2
 
 
 class GridUnsupported(Exception):
     """Raised when a hashgraph state cannot be expressed as a dense grid
-    (e.g. post-reset roots with `others` entries) — callers fall back to
+    (an other-parent that is resolvable nowhere) — callers fall back to
     the CPU engine."""
 
 
 def grid_from_hashgraph(hg) -> DagGrid:
     """Extract the dense grid from a host Hashgraph's store.
 
-    Only undetermined-from-scratch hashgraphs with base-style roots are
-    supported; frames/reset roots carry `others` entries and raise
-    GridUnsupported.
-    """
+    Handles base and post-reset states: parents covered by roots
+    (self-parent hashes, `others` entries) are folded into the per-event
+    external metadata the same way the host round/lamport recursion
+    resolves them (reference: src/hashgraph/hashgraph.go:205-278)."""
     from ..hashgraph.hashgraph import middle_bit
 
     participants = hg.participants.to_peer_slice()
     n = len(participants)
 
-    root_next_round = np.full(n, 0, dtype=np.int32)
-    root_sp_round = np.full(n, -1, dtype=np.int32)
-    root_sp_lamport = np.full(n, -1, dtype=np.int32)
-    for pos, p in enumerate(participants):
-        root = hg.store.get_root(p.pub_key_hex)
-        if root.others:
-            raise GridUnsupported("roots with `others` entries (post-reset state)")
-        root_next_round[pos] = root.next_round
-        root_sp_round[pos] = root.self_parent.round
-        root_sp_lamport[pos] = root.self_parent.lamport_timestamp
+    roots = {p.pub_key_hex: hg.store.get_root(p.pub_key_hex) for p in participants}
+    roots_by_sp = hg.store.roots_by_self_parent()
 
     events = []
     for p in participants:
@@ -99,20 +119,48 @@ def grid_from_hashgraph(hg) -> DagGrid:
     la = np.full((e_count, n), -1, dtype=np.int32)
     fd = np.full((e_count, n), MAX_INT32, dtype=np.int32)
     coin = np.zeros(e_count, dtype=bool)
+    fixed_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_round = np.full(e_count, -1, dtype=np.int32)
+    ext_op_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
+    ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
     hashes = [ev.hex() for ev in events]
 
     for i, ev in enumerate(events):
         creator[i] = hg.peer_position(ev.creator())
         index[i] = ev.index()
+        root = roots[ev.creator()]
+        other = root.others.get(ev.hex())
         sp = ev.self_parent()
+        op = ev.other_parent()
+
         if sp in row_of:
             self_parent[i] = row_of[sp]
-        op = ev.other_parent()
+        elif sp == root.self_parent.hash:
+            ext_sp_round[i] = root.self_parent.round
+            ext_sp_lamport[i] = root.self_parent.lamport_timestamp
+            # directly attached to the root: round is forced to next_round
+            # (reference: hashgraph.go:207-236)
+            if op == "" or (other is not None and other.hash == op):
+                fixed_round[i] = root.next_round
+        else:
+            raise GridUnsupported(f"self-parent unresolvable: {sp[:18]}…")
+
         if op != "":
-            if op in row_of:
+            if other is not None and other.hash == op:
+                # other-parent covered by the root's `others` map
+                ext_op_round[i] = root.next_round
+                ext_op_lamport[i] = other.lamport_timestamp
+            elif op in row_of:
                 other_parent[i] = row_of[op]
+            elif op in roots_by_sp:
+                opr = roots_by_sp[op]
+                ext_op_round[i] = opr.self_parent.round
+                # mirrors the host lamport cache-miss behavior for root
+                # self-parent hashes (hashgraph.py _lamport_once): stays MIN
             else:
-                raise GridUnsupported(f"other-parent outside grid: {op[:18]}…")
+                raise GridUnsupported(f"other-parent unresolvable: {op[:18]}…")
+
         la[i] = [c[0] for c in ev.last_ancestors]
         fd[i] = [c[0] for c in ev.first_descendants]
         coin[i] = middle_bit(ev.hex())
@@ -130,9 +178,11 @@ def grid_from_hashgraph(hg) -> DagGrid:
         last_ancestors=la,
         first_descendants=fd,
         coin_bit=coin,
-        root_next_round=root_next_round,
-        root_sp_round=root_sp_round,
-        root_sp_lamport=root_sp_lamport,
+        fixed_round=fixed_round,
+        ext_sp_round=ext_sp_round,
+        ext_op_round=ext_op_round,
+        ext_sp_lamport=ext_sp_lamport,
+        ext_op_lamport=ext_op_lamport,
         levels=levels,
         num_levels=num_levels,
         hashes=hashes,
@@ -248,6 +298,16 @@ def synthetic_grid(
     coin = rng.integers(0, 2, size=e_count).astype(bool)
     levels, num_levels = build_levels(n, self_parent, other_parent)
 
+    # base-root external metadata: first events per creator attach to base
+    # roots (next_round 0, self-parent round/lamport -1)
+    fixed_round = np.where(
+        (self_parent < 0) & (other_parent < 0), 0, -1
+    ).astype(np.int32)
+    ext_sp_round = np.full(e_count, -1, dtype=np.int32)
+    ext_op_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
+    ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+
     return DagGrid(
         n=n,
         e=e_count,
@@ -259,11 +319,11 @@ def synthetic_grid(
         last_ancestors=la,
         first_descendants=fd,
         coin_bit=coin,
-        root_next_round=np.zeros(n, dtype=np.int32),
-        root_sp_round=np.full(n, -1, dtype=np.int32),
-        root_sp_lamport=np.full(n, -1, dtype=np.int32),
+        fixed_round=fixed_round,
+        ext_sp_round=ext_sp_round,
+        ext_op_round=ext_op_round,
+        ext_sp_lamport=ext_sp_lamport,
+        ext_op_lamport=ext_op_lamport,
         levels=levels,
         num_levels=num_levels,
     )
-
-

@@ -13,17 +13,25 @@ tests/test_tpu_differential.py. The mapping from the reference algorithms
 - DivideRounds -> lax.scan over topological *levels* (<= N events each,
   ancestors strictly below), each step vectorized: parent-round max, then
   strongly-see counts against the parent round's witness row of the
-  (R, N) witness table, then witness/lamport updates by scatter.
-- DecideFame -> one scan over the round-offset d, *batched over all rounds
-  i simultaneously*: votes[i] is an (N, N) creator-indexed matrix; the
-  vote count "yays(y,x) = sum_w stronglySee(y,w) * vote(w,x)"
+  (R, N) witness table, then witness/lamport updates by scatter. External
+  parents (roots, reset `others` entries) arrive as per-event host-resolved
+  metadata (reference root cases: hashgraph.go:205-278).
+- DecideFame -> a while_loop over the round-offset d, *batched over all
+  rounds i simultaneously*: votes[i] is an (N, N) creator-indexed matrix;
+  the vote count "yays(y,x) = sum_w stronglySee(y,w) * vote(w,x)"
   (reference: hashgraph.go:886-911) is a batched (R, N, N) float matmul —
   MXU work. Coin rounds substitute the precomputed event-hash middle bit
-  (reference: hashgraph.go:922-928,1526-1535).
+  (reference: hashgraph.go:922-928,1526-1535). The loop exits as soon as no
+  undecided witness has voting rounds left (<= last_round) — extra
+  iterations can never change a decided witness (first decision wins), and
+  skipped iterations have no valid voters, so early exit is bit-exact.
 - DecideRoundReceived -> per-round famous-witness column minima of
   lastAncestors: event e is seen by ALL famous witnesses of round i iff
   index[e] <= min over famous w of lastAnc[w][creator[e]] — an (R, N)
   table + an (E, R) masked argmin (reference: hashgraph.go:988-1001).
+
+The full pipeline compiles as ONE XLA program (`consensus_pipeline`): no
+host round-trips between passes; `last_round` is computed on device.
 
 All shapes static; padding rows are -1/masked.
 """
@@ -37,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 MAX_INT32 = 2**31 - 1
+MIN_INT32 = -(2**31)
 NEG = jnp.int32(-1)
 
 
@@ -53,20 +62,22 @@ class FameResult(NamedTuple):
     rounds_decided: jax.Array  # (R,) bool — all witnesses of round decided
 
 
-@functools.partial(jax.jit, static_argnames=("r_max",))
-def divide_rounds(
-    levels: jax.Array,  # (L, N) int32 event rows, -1 padded
-    creator: jax.Array,  # (E,) int32
-    index: jax.Array,  # (E,) int32
-    self_parent: jax.Array,  # (E,) int32
-    other_parent: jax.Array,  # (E,) int32
-    la: jax.Array,  # (E, N) int32
-    fd: jax.Array,  # (E, N) int32
-    root_next_round: jax.Array,  # (N,) int32
-    root_sp_round: jax.Array,  # (N,) int32
-    root_sp_lamport: jax.Array,  # (N,) int32
-    super_majority: int,
-    r_max: int,
+class PipelineResult(NamedTuple):
+    rounds: jax.Array  # (E,) int32
+    witness: jax.Array  # (E,) bool
+    lamport: jax.Array  # (E,) int32
+    witness_table: jax.Array  # (R, N) int32
+    fame_decided: jax.Array  # (R, N) bool
+    famous: jax.Array  # (R, N) bool
+    rounds_decided: jax.Array  # (R,) bool
+    received: jax.Array  # (E,) int32
+    last_round: jax.Array  # () int32
+
+
+def _divide_rounds(
+    levels, creator, index, self_parent, other_parent, la, fd,
+    ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport, ext_op_lamport,
+    super_majority: int, r_max: int,
 ) -> DivideRoundsResult:
     e_count, n = la.shape
 
@@ -82,8 +93,8 @@ def divide_rounds(
         sp = self_parent[rows]
         op = other_parent[rows]
 
-        sp_round = jnp.where(sp >= 0, rounds[jnp.maximum(sp, 0)], root_sp_round[c])
-        op_round = jnp.where(op >= 0, rounds[jnp.maximum(op, 0)], NEG)
+        sp_round = jnp.where(sp >= 0, rounds[jnp.maximum(sp, 0)], ext_sp_round[rows])
+        op_round = jnp.where(op >= 0, rounds[jnp.maximum(op, 0)], ext_op_round[rows])
         parent_round = jnp.maximum(sp_round, op_round)
 
         # strongly-see counts against the parent round's witnesses
@@ -96,14 +107,15 @@ def divide_rounds(
         c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
 
         new_round = parent_round + (c_seen >= super_majority).astype(jnp.int32)
-        # events attached directly to the root (no parents in the grid)
-        root_attached = (sp < 0) & (op < 0)
-        new_round = jnp.where(root_attached, root_next_round[c], new_round)
+        # root-attached events have their round forced (reference root
+        # cases: hashgraph.go:207-236)
+        fixed = fixed_round[rows]
+        new_round = jnp.where(fixed >= 0, fixed, new_round)
 
         new_witness = new_round > sp_round
 
-        sp_lt = jnp.where(sp >= 0, lamport[jnp.maximum(sp, 0)], root_sp_lamport[c])
-        op_lt = jnp.where(op >= 0, lamport[jnp.maximum(op, 0)], -(2**31))
+        sp_lt = jnp.where(sp >= 0, lamport[jnp.maximum(sp, 0)], ext_sp_lamport[rows])
+        op_lt = jnp.where(op >= 0, lamport[jnp.maximum(op, 0)], ext_op_lamport[rows])
         new_lt = jnp.maximum(sp_lt, op_lt) + 1
 
         rounds = rounds.at[scatter_rows].set(new_round, mode="drop")
@@ -126,20 +138,9 @@ def divide_rounds(
     return DivideRoundsResult(rounds, witness, lamport, wtable)
 
 
-@functools.partial(jax.jit, static_argnames=("super_majority", "n_participants", "d_max"))
-def decide_fame(
-    wtable: jax.Array,  # (R, N) int32 witness rows
-    la: jax.Array,  # (E, N)
-    fd: jax.Array,  # (E, N)
-    index: jax.Array,  # (E,)
-    coin_bit: jax.Array,  # (E,) bool
-    last_round: jax.Array,  # () int32
-    super_majority: int,
-    n_participants: int,
-    d_max: int,
-) -> FameResult:
-    """Virtual voting, batched over every round i at once; scan over the
-    round offset d (j = i + d)."""
+def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int):
+    """Shared DecideFame preamble: the round-adjacent strongly-see tensor
+    and the d=1 ancestry votes (reference: hashgraph.go:875-884)."""
     r_max, n = wtable.shape
     wvalid = wtable >= 0
     wrows = jnp.maximum(wtable, 0)
@@ -160,11 +161,30 @@ def decide_fame(
     see0 = la_next >= idx_w[:, None, :]
     valid_y0 = jnp.roll(wvalid, -1, axis=0).at[r_max - 1].set(False)
     votes0 = see0 & valid_y0[:, :, None]
+    return ss, votes0, wvalid, coin_w
+
+
+def _decide_fame(
+    wtable, la, fd, index, coin_bit, last_round,
+    super_majority: int, n_participants: int, d_cap: int,
+) -> FameResult:
+    """Virtual voting, batched over every round i at once; while_loop over
+    the round offset d (j = i + d) with bit-exact early exit."""
+    r_max, n = wtable.shape
+    ss, votes0, wvalid, coin_w = _fame_setup(
+        wtable, la, fd, index, coin_bit, super_majority
+    )
 
     i_arr = jnp.arange(r_max)
 
-    def step(carry, d):
-        votes, decided, famous = carry
+    def cond(carry):
+        votes, decided, famous, d = carry
+        # a future voting round exists for some undecided witness
+        active = wvalid & ~decided & ((i_arr[:, None] + d) <= last_round)
+        return (d <= d_cap) & jnp.any(active)
+
+    def body(carry):
+        votes, decided, famous, d = carry
         j = i_arr + d  # per-i absolute round of the voters
         j_ok = j <= last_round
         jc = jnp.clip(j, 0, r_max - 1)
@@ -200,42 +220,26 @@ def decide_fame(
 
         coin_votes = jnp.where(strong, v, coin_w[jc][:, :, None])
         votes_next = jnp.where(is_coin, coin_votes, v)
-        return (votes_next, decided, famous), None
+        return (votes_next, decided, famous, d + 1)
 
     init = (
         votes0,
         jnp.zeros((r_max, n), dtype=bool),
         jnp.zeros((r_max, n), dtype=bool),
+        jnp.int32(2),
     )
-    ds = jnp.arange(2, d_max + 2)
-    (votes, decided, famous), _ = jax.lax.scan(step, init, ds)
+    votes, decided, famous, _ = jax.lax.while_loop(cond, body, init)
 
     # rounds with no witnesses at all don't exist; treat as not decided
     rounds_decided = jnp.all(decided | ~wvalid, axis=1) & jnp.any(wvalid, axis=1)
     return FameResult(decided, famous, rounds_decided)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def decide_round_received(
-    wtable: jax.Array,  # (R, N)
-    la: jax.Array,  # (E, N)
-    index: jax.Array,  # (E,)
-    creator: jax.Array,  # (E,)
-    rounds: jax.Array,  # (E,)
-    decided: jax.Array,  # (R, N) fame decided per witness
-    famous: jax.Array,  # (R, N) fame value
-    rounds_decided: jax.Array,  # (R,)
-    last_round: jax.Array,  # ()
-) -> jax.Array:
-    """Round-received per event; -1 when still undetermined.
-
-    received(e) = min { i > round(e) : every round in (round(e), i] is
-    fully fame-decided, round i has >= 1 famous witness, and all famous
-    witnesses of i see e } (reference: hashgraph.go:951-1036).
-    """
+def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
+    """Per-round tables consumed by the round-received search: famous-witness
+    counts, column minima of famous witnesses' lastAncestors, eligibility,
+    and the first-undecided-round suffix scan."""
     r_max, n = wtable.shape
-    e_count = la.shape[0]
-
     is_famous = decided & famous & (wtable >= 0)  # (R, N)
     famous_count = jnp.sum(is_famous, axis=1)  # (R,)
 
@@ -245,12 +249,30 @@ def decide_round_received(
         jnp.where(is_famous[:, :, None], la_w, MAX_INT32), axis=1
     )  # (R, N_c)
 
-    i_ok = rounds_decided & (jnp.arange(r_max) <= last_round)
+    idx = jnp.arange(r_max)
+    i_ok = rounds_decided & (idx <= last_round)
     # first non-decided round at-or-after k, as a suffix-scan:
     # horizon[k] = min{ i >= k : not i_ok[i] }  (r_max if none)
-    idx = jnp.arange(r_max)
     bad = jnp.where(~i_ok, idx, r_max)
     horizon = jax.lax.associative_scan(jnp.minimum, bad, reverse=True)  # (R,)
+    return min_la, famous_count, i_ok, horizon
+
+
+def _decide_round_received(
+    wtable, la, index, creator, rounds, decided, famous, rounds_decided,
+    last_round,
+) -> jax.Array:
+    """Round-received per event; -1 when still undetermined.
+
+    received(e) = min { i > round(e) : every round in (round(e), i] is
+    fully fame-decided, round i has >= 1 famous witness, and all famous
+    witnesses of i see e } (reference: hashgraph.go:951-1036).
+    """
+    r_max, n = wtable.shape
+    min_la, famous_count, i_ok, horizon = _received_tables(
+        wtable, la, decided, famous, rounds_decided, last_round
+    )
+    idx = jnp.arange(r_max)
 
     # candidate matrix (E, R): event e received at round i?
     seen_all = index[:, None] <= min_la[:, creator].T  # (E, R)
@@ -267,3 +289,66 @@ def decide_round_received(
 
     received = jnp.min(jnp.where(cand, idx[None, :], r_max), axis=1)
     return jnp.where(received == r_max, -1, received).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("super_majority", "n_participants", "r_max", "d_cap")
+)
+def consensus_pipeline(
+    levels: jax.Array,  # (L, N) int32 event rows, -1 padded
+    creator: jax.Array,  # (E,) int32
+    index: jax.Array,  # (E,) int32
+    self_parent: jax.Array,  # (E,) int32
+    other_parent: jax.Array,  # (E,) int32
+    la: jax.Array,  # (E, N) int32
+    fd: jax.Array,  # (E, N) int32
+    ext_sp_round: jax.Array,  # (E,) int32
+    ext_op_round: jax.Array,  # (E,) int32
+    fixed_round: jax.Array,  # (E,) int32
+    ext_sp_lamport: jax.Array,  # (E,) int32
+    ext_op_lamport: jax.Array,  # (E,) int32
+    coin_bit: jax.Array,  # (E,) bool
+    super_majority: int,
+    n_participants: int,
+    r_max: int,
+    d_cap: int,
+) -> PipelineResult:
+    """DivideRounds + DecideFame + DecideRoundReceived as one XLA program."""
+    dr = _divide_rounds(
+        levels, creator, index, self_parent, other_parent, la, fd,
+        ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport,
+        ext_op_lamport, super_majority, r_max,
+    )
+    last_round = jnp.max(dr.rounds)
+    fame = _decide_fame(
+        dr.witness_table, la, fd, index, coin_bit, last_round,
+        super_majority, n_participants, d_cap,
+    )
+    received = _decide_round_received(
+        dr.witness_table, la, index, creator, dr.rounds,
+        fame.decided, fame.famous, fame.rounds_decided, last_round,
+    )
+    return PipelineResult(
+        rounds=dr.rounds,
+        witness=dr.witness,
+        lamport=dr.lamport,
+        witness_table=dr.witness_table,
+        fame_decided=fame.decided,
+        famous=fame.famous,
+        rounds_decided=fame.rounds_decided,
+        received=received,
+        last_round=last_round,
+    )
+
+
+# -- individually-jitted kernels (tests, sharded dryrun) ---------------------
+
+divide_rounds = functools.partial(jax.jit, static_argnames=("super_majority", "r_max"))(
+    _divide_rounds
+)
+
+decide_fame = functools.partial(
+    jax.jit, static_argnames=("super_majority", "n_participants", "d_cap")
+)(_decide_fame)
+
+decide_round_received = jax.jit(_decide_round_received)

@@ -1,0 +1,257 @@
+"""Multi-chip SPMD consensus: the virtual-voting pipeline partitioned over
+a `jax.sharding.Mesh` (SURVEY.md §5 "events-dimension sharding";
+BASELINE.json config #5).
+
+Layout — who owns what:
+
+- **DivideRounds** runs replicated (dp-style redundant compute): it is a
+  sequential scan over topological levels whose state is the small (E,)
+  round/lamport vectors — there is nothing worth sharding and everything
+  downstream needs its outputs.
+- **DecideFame** — the FLOPs — shards over the *rounds* axis. Each device
+  owns R/ndev rounds' (N, N) vote matmuls. The voters of step d live at
+  round j = i + d, i.e. d rows ahead of the decided round i, so the
+  strongly-see tensor is kept aligned by ring-shifting one row per voting
+  step with `lax.ppermute` over ICI — the same neighbor-exchange pattern as
+  ring attention, applied to reachability matrices. Early exit is
+  host-chunked: `chunk` voting steps per dispatch, stop when no undecided
+  witness has voting rounds left (bit-exact: extra steps never overwrite a
+  decision, skipped steps have no valid voters).
+- **DecideRoundReceived** shards over the *events* axis: given the small
+  replicated (R, N) fame tables it is a pure per-event map.
+
+Differentially verified against the single-device pipeline in
+tests/test_multichip.py on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import kernels
+from .engine import PassResults
+from .grid import DagGrid
+from .kernels import MAX_INT32
+
+
+def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full((size,) + a.shape[1:], fill, dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def _fame_chunk_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
+                   super_majority: int):
+    """Build the shard_mapped fame voting chunk for a mesh (cached so
+    repeated batches reuse the compiled executable)."""
+    ndev = int(np.prod(mesh.devices.shape))
+    # send my first row to the previous device: a left ring-shift of the
+    # globally R-sharded j-aligned tensors
+    perm = [(i, (i - 1) % ndev) for i in range(ndev)]
+
+    def local_chunk(last_round, d0, i_rows, wvalid, votes, decided, famous,
+                    ss_s, wv_s, coin_s):
+        def shift1(x):
+            recv = jax.lax.ppermute(x[:1], axis, perm)
+            return jnp.concatenate([x[1:], recv], axis=0)
+
+        def step(carry, k):
+            votes, decided, famous, ss_s, wv_s, coin_s = carry
+            d = d0 + k
+            j = i_rows + d  # absolute voter round per local row
+            j_ok = j <= last_round
+
+            ss_d = ss_s & j_ok[:, None, None]  # (B, N_y, N_w)
+            vy = wv_s & j_ok[:, None]  # (B, N_y)
+
+            yays = jnp.einsum(
+                "ryw,rwx->ryx",
+                ss_d.astype(jnp.float32),
+                votes.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
+            nays = total[:, :, None] - yays
+            v = yays >= nays
+            t = jnp.where(v, yays, nays)
+
+            is_coin = (d % n_participants) == 0
+            strong = t >= super_majority
+
+            decide_now = (
+                (~is_coin)
+                & strong
+                & vy[:, :, None]
+                & wvalid[:, None, :]
+                & (~decided[:, None, :])
+            )
+            any_decide = jnp.any(decide_now, axis=1)
+            fame_val = jnp.any(decide_now & v, axis=1)
+            famous = jnp.where(any_decide, fame_val, famous)
+            decided = decided | any_decide
+
+            coin_votes = jnp.where(strong, v, coin_s[:, :, None])
+            votes = jnp.where(is_coin, coin_votes, v)
+            return (votes, decided, famous, shift1(ss_s), shift1(wv_s),
+                    shift1(coin_s)), None
+
+        carry = (votes, decided, famous, ss_s, wv_s, coin_s)
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(chunk))
+        votes, decided, famous, ss_s, wv_s, coin_s = carry
+
+        # does any undecided witness still have voting rounds left?
+        local_active = jnp.any(
+            wvalid & ~decided & ((i_rows[:, None] + d0 + chunk) <= last_round)
+        )
+        active = jax.lax.psum(local_active.astype(jnp.int32), axis) > 0
+        return votes, decided, famous, ss_s, wv_s, coin_s, active
+
+    shp = P(axis)
+    shp2 = P(axis, None)
+    shp3 = P(axis, None, None)
+    rep = P()
+    return jax.jit(
+        jax.shard_map(
+            local_chunk,
+            mesh=mesh,
+            in_specs=(rep, rep, shp, shp2, shp3, shp2, shp2, shp3, shp2, shp2),
+            out_specs=(shp3, shp2, shp2, shp3, shp2, shp2, rep),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _received_fn(mesh: Mesh, axis: str):
+    """shard_mapped DecideRoundReceived: events sharded, fame tables
+    replicated; pure local map (no collectives needed)."""
+
+    def local_received(index, creator, rounds, min_la, famous_count, i_ok,
+                       horizon):
+        r_pad = min_la.shape[0]
+        idxr = jnp.arange(r_pad)
+        seen_all = index[:, None] <= min_la[:, creator].T  # (B, R)
+        cand = (
+            seen_all
+            & (famous_count[None, :] > 0)
+            & i_ok[None, :]
+            & (idxr[None, :] > rounds[:, None])
+        )
+        start = jnp.clip(rounds + 1, 0, r_pad - 1)
+        cand = cand & (idxr[None, :] < horizon[start][:, None])
+        received = jnp.min(jnp.where(cand, idxr[None, :], r_pad), axis=1)
+        return jnp.where(received == r_pad, -1, received).astype(jnp.int32)
+
+    shp = P(axis)
+    rep = P()
+    return jax.jit(
+        jax.shard_map(
+            local_received,
+            mesh=mesh,
+            in_specs=(shp, shp, shp, rep, rep, rep, rep),
+            out_specs=shp,
+        )
+    )
+
+
+@jax.jit
+def _fame_tables(wtable, la, decided, famous, last_round):
+    """Replicated post-fame tables consumed by the received map (shared
+    table math: kernels._received_tables)."""
+    wvalid = wtable >= 0
+    rounds_decided = jnp.all(decided | ~wvalid, axis=1) & jnp.any(wvalid, axis=1)
+    min_la, famous_count, i_ok, horizon = kernels._received_tables(
+        wtable, la, decided, famous, rounds_decided, last_round
+    )
+    return min_la, famous_count, i_ok, horizon, rounds_decided
+
+
+def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults:
+    """Full three-pass pipeline over a device mesh; results identical to
+    the single-device `engine.run_passes` (differential-tested)."""
+    axis = mesh.axis_names[0]
+    ndev = int(np.prod(mesh.devices.shape))
+    rep = NamedSharding(mesh, P())
+    shard_r = NamedSharding(mesh, P(axis))
+    shard_r2 = NamedSharding(mesh, P(axis, None))
+    shard_r3 = NamedSharding(mesh, P(axis, None, None))
+
+    r_max = grid.r_max
+    r_pad = ((r_max + ndev - 1) // ndev) * ndev
+    e_pad = ((max(grid.e, 1) + ndev - 1) // ndev) * ndev
+
+    # ---- pass 1: DivideRounds, replicated over the mesh ----
+    # device_put straight from numpy: never touches the default backend, so
+    # the pipeline runs entirely on the mesh's devices (the dryrun relies on
+    # this to stay off the real TPU)
+    putr = lambda x: jax.device_put(np.asarray(x), rep)
+    la = putr(grid.last_ancestors)
+    fd = putr(grid.first_descendants)
+    index = putr(grid.index)
+    dr = kernels.divide_rounds(
+        putr(grid.levels), putr(grid.creator), index,
+        putr(grid.self_parent), putr(grid.other_parent), la, fd,
+        putr(grid.ext_sp_round), putr(grid.ext_op_round),
+        putr(grid.fixed_round), putr(grid.ext_sp_lamport),
+        putr(grid.ext_op_lamport), grid.super_majority, r_max,
+    )
+    last_round = jnp.max(dr.rounds)
+
+    # ---- pass 2: DecideFame, rounds-sharded with ring-shifted voters ----
+    wtable_np = _pad_axis0(np.asarray(dr.witness_table), r_pad, -1)
+    wtable = putr(wtable_np)
+    ss, votes0, wvalid, coin_w = kernels._fame_setup(
+        wtable, la, fd, index, putr(grid.coin_bit), grid.super_majority
+    )
+    # j-aligned buffers start at d0=2: a global left-shift by 2
+    ss_s = jax.device_put(jnp.roll(ss, -2, axis=0), shard_r3)
+    wv_s = jax.device_put(jnp.roll(wvalid, -2, axis=0), shard_r2)
+    coin_s = jax.device_put(jnp.roll(coin_w, -2, axis=0), shard_r2)
+    votes = jax.device_put(votes0, shard_r3)
+    wvalid_s = jax.device_put(wvalid, shard_r2)
+    decided = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
+    famous = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
+    i_rows = jax.device_put(np.arange(r_pad, dtype=np.int32), shard_r)
+
+    fame_chunk = _fame_chunk_fn(mesh, axis, chunk, grid.n, grid.super_majority)
+    d0 = 2
+    while True:
+        votes, decided, famous, ss_s, wv_s, coin_s, active = fame_chunk(
+            last_round, np.int32(d0), i_rows, wvalid_s, votes, decided,
+            famous, ss_s, wv_s, coin_s,
+        )
+        d0 += chunk
+        if not bool(active) or d0 > r_pad + 2:
+            break
+
+    # ---- pass 3: DecideRoundReceived, events-sharded ----
+    min_la, famous_count, i_ok, horizon, rounds_decided = _fame_tables(
+        wtable, la, decided, famous, last_round
+    )
+    pute = lambda x, fill: jax.device_put(
+        _pad_axis0(np.asarray(x), e_pad, fill), NamedSharding(mesh, P(axis))
+    )
+    received = _received_fn(mesh, axis)(
+        pute(grid.index, 0), pute(grid.creator, 0),
+        pute(np.asarray(dr.rounds), -1),
+        jax.device_put(min_la, rep), jax.device_put(famous_count, rep),
+        jax.device_put(i_ok, rep), jax.device_put(horizon, rep),
+    )
+
+    return PassResults(
+        rounds=np.asarray(dr.rounds),
+        witness=np.asarray(dr.witness),
+        lamport=np.asarray(dr.lamport),
+        witness_table=np.asarray(dr.witness_table),
+        fame_decided=np.asarray(decided)[:r_max],
+        famous=np.asarray(famous)[:r_max],
+        rounds_decided=np.asarray(rounds_decided)[:r_max],
+        received=np.asarray(received)[: grid.e],
+        last_round=int(last_round),
+    )

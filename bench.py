@@ -37,13 +37,16 @@ def load_grid():
     from babble_tpu.tpu.grid import DagGrid, build_levels, synthetic_grid
 
     if os.path.exists(CACHE):
+        from babble_tpu.tpu.grid import MIN_INT32
+
         z = np.load(CACHE)
         levels, num_levels = build_levels(
             N_VALIDATORS, z["self_parent"], z["other_parent"]
         )
+        e = N_EVENTS
         return DagGrid(
             n=N_VALIDATORS,
-            e=N_EVENTS,
+            e=e,
             super_majority=2 * N_VALIDATORS // 3 + 1,
             creator=z["creator"],
             index=z["index"],
@@ -52,9 +55,13 @@ def load_grid():
             last_ancestors=z["la"],
             first_descendants=z["fd"],
             coin_bit=z["coin"],
-            root_next_round=np.zeros(N_VALIDATORS, dtype=np.int32),
-            root_sp_round=np.full(N_VALIDATORS, -1, dtype=np.int32),
-            root_sp_lamport=np.full(N_VALIDATORS, -1, dtype=np.int32),
+            fixed_round=np.where(
+                (z["self_parent"] < 0) & (z["other_parent"] < 0), 0, -1
+            ).astype(np.int32),
+            ext_sp_round=np.full(e, -1, dtype=np.int32),
+            ext_op_round=np.full(e, -1, dtype=np.int32),
+            ext_sp_lamport=np.full(e, -1, dtype=np.int32),
+            ext_op_lamport=np.full(e, MIN_INT32, dtype=np.int32),
             levels=levels,
             num_levels=num_levels,
         )

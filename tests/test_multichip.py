@@ -1,0 +1,71 @@
+"""Multi-device CPU differential tests: the sharded SPMD pipeline
+(babble_tpu/tpu/sharded.py) must produce exactly the single-device
+pipeline's outputs on every topology (conftest pins JAX to a virtual
+8-device CPU platform)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from babble_tpu.tpu import grid_from_hashgraph, run_passes, synthetic_grid
+from babble_tpu.tpu.sharded import sharded_run_passes
+
+from dsl import init_consensus_hashgraph, init_simple_hashgraph
+
+
+def make_mesh(n_devices):
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        pytest.skip(f"need {n_devices} CPU devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n_devices]), ("rounds",))
+
+
+def assert_sharded_matches(grid, n_devices):
+    mesh = make_mesh(n_devices)
+    sharded = sharded_run_passes(mesh, grid)
+    single = run_passes(grid)
+
+    np.testing.assert_array_equal(sharded.rounds, single.rounds)
+    np.testing.assert_array_equal(sharded.witness, single.witness)
+    np.testing.assert_array_equal(sharded.lamport, single.lamport)
+    np.testing.assert_array_equal(sharded.fame_decided, single.fame_decided)
+    np.testing.assert_array_equal(
+        sharded.famous & sharded.fame_decided,
+        single.famous & single.fame_decided,
+    )
+    np.testing.assert_array_equal(sharded.rounds_decided, single.rounds_decided)
+    np.testing.assert_array_equal(sharded.received, single.received)
+    assert sharded.last_round == single.last_round
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_synthetic_sharded_differential(n_devices):
+    grid = synthetic_grid(8, 192, seed=11)
+    assert_sharded_matches(grid, n_devices)
+
+
+def test_zipf_sharded_differential():
+    grid = synthetic_grid(16, 384, seed=23, zipf_a=1.1)
+    assert_sharded_matches(grid, 8)
+
+
+def test_fixture_sharded_differential():
+    """Named consensus fixture through the sharded pipeline."""
+    hg, _, _ = init_consensus_hashgraph()
+    grid = grid_from_hashgraph(hg)
+    assert_sharded_matches(grid, 4)
+
+
+def test_simple_fixture_sharded_differential():
+    hg, _, _ = init_simple_hashgraph()
+    grid = grid_from_hashgraph(hg)
+    assert_sharded_matches(grid, 2)
+
+
+def test_dryrun_multichip_entrypoint():
+    """The driver's dryrun must pass end-to-end on the CPU mesh."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
