@@ -19,6 +19,12 @@ class Config:
     tcp_timeout: float = 1.0
     cache_size: int = 500
     sync_limit: int = 100
+    # consensus backend: "cpu" runs the scalar five-pass pipeline on host;
+    # "tpu" dispatches DivideRounds/DecideFame/DecideRoundReceived to the
+    # device kernels (babble_tpu/tpu/), falling back to the CPU path on any
+    # state the dense grid cannot express (SURVEY §7 swappable-backend plan;
+    # reference boundary: src/node/core.go:335-377)
+    consensus_backend: str = "cpu"
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -29,6 +29,7 @@ class Core:
         store: Store,
         commit_ch: Optional["queue.Queue[Block]"] = None,
         logger: Optional[logging.Logger] = None,
+        consensus_backend: str = "cpu",
     ):
         self.id = id_
         self.key = key
@@ -46,6 +47,15 @@ class Core:
         self.seq: int = -1
         self.transaction_pool: List[bytes] = []
         self.block_signature_pool: List[BlockSignature] = []
+        if consensus_backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown consensus backend: {consensus_backend!r}")
+        self.consensus_backend = consensus_backend
+        self.device_consensus_runs = 0
+        self.device_consensus_fallbacks = 0
+        # sticky: set when the hashgraph state stops being grid-expressible
+        # (e.g. a rolled store window); cleared on fast-forward, which
+        # compacts the state back into grid range
+        self._device_down = False
 
     # -- identity ----------------------------------------------------------
 
@@ -161,6 +171,7 @@ class Core:
         if section is not None:
             self.hg.apply_section(section)
         self.set_head_and_seq()
+        self._device_down = False  # reset compacted the state back into range
         self.run_consensus()
 
     def add_self_event(self, other_head: str) -> None:
@@ -190,6 +201,27 @@ class Core:
     # -- consensus ---------------------------------------------------------
 
     def run_consensus(self) -> None:
+        """Five-pass pipeline through the configured backend. The device
+        path covers passes 1-3 (grid extraction + fused XLA pipeline) and
+        falls back to the host engine on any state the dense grid cannot
+        express (reference boundary: src/node/core.go:335-377)."""
+        if self.consensus_backend == "tpu" and not self._device_down:
+            from ..tpu.engine import run_consensus_device
+            from ..tpu.grid import GridUnsupported
+
+            try:
+                run_consensus_device(self.hg)
+                self.device_consensus_runs += 1
+                return
+            except GridUnsupported as e:
+                # unsupported states (rolled windows) only grow worse until
+                # the next reset — disable instead of failing every tick
+                self._device_down = True
+                self.device_consensus_fallbacks += 1
+                self.logger.warning(
+                    "device consensus unsupported (%s); using CPU until the "
+                    "next fast-forward", e
+                )
         self.hg.run_consensus()
 
     def add_transactions(self, txs: List[bytes]) -> None:

@@ -62,7 +62,10 @@ class Node(NodeStateMachine):
 
         pmap = store.participants()
         self.commit_ch: "queue.Queue[Block]" = queue.Queue(maxsize=400)
-        self.core = Core(id_, key, pmap, store, self.commit_ch, conf.logger)
+        self.core = Core(
+            id_, key, pmap, store, self.commit_ch, conf.logger,
+            consensus_backend=conf.consensus_backend,
+        )
         self.core_lock = threading.Lock()
         self.selector_lock = threading.Lock()
         self.peer_selector = RandomPeerSelector(participants, self.local_addr)

@@ -17,7 +17,7 @@ from typing import Optional
 
 import numpy as np
 
-from .grid import DagGrid, GridUnsupported, grid_from_hashgraph
+from .grid import MAX_INT32, MIN_INT32, DagGrid, GridUnsupported, grid_from_hashgraph
 from . import kernels
 
 
@@ -36,13 +36,83 @@ class PassResults:
     last_round: int
 
 
-def run_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
+def _bucket(x: int, floor: int, factor: int = 4) -> int:
+    """Next floor*factor^k >= x — the static-shape schedule that amortizes
+    XLA recompiles as a live DAG grows (SURVEY §7 hard-part #3). The coarse
+    factor keeps the number of distinct compiled shapes a live node ever
+    sees to a handful (each compile stalls gossip under core_lock)."""
+    b = floor
+    while b < x:
+        b *= factor
+    return b
+
+
+def pad_grid(grid: DagGrid) -> DagGrid:
+    """Pad the event axis and the level table to bucketed static shapes.
+
+    Padding rows are inert by construction: they never appear in `levels`
+    (so the DivideRounds scan never scatters to them, their round stays -1),
+    index=MAX keeps them out of every round-received candidate set, and
+    la=-1/fd=MAX make them invisible to any ancestry comparison."""
+    e_b = _bucket(grid.e, 256)
+    l_b = _bucket(grid.num_levels, 128)
+    if e_b == grid.e and l_b == grid.levels.shape[0]:
+        return grid
+    pad_e = e_b - grid.e
+    n = grid.n
+
+    def pad1(a, fill):
+        return np.concatenate([a, np.full(pad_e, fill, dtype=a.dtype)])
+
+    levels = np.full((l_b, n), -1, dtype=np.int32)
+    levels[: grid.levels.shape[0]] = grid.levels
+
+    return DagGrid(
+        n=n,
+        e=grid.e,
+        super_majority=grid.super_majority,
+        creator=pad1(grid.creator, 0),
+        index=pad1(grid.index, MAX_INT32),
+        self_parent=pad1(grid.self_parent, -1),
+        other_parent=pad1(grid.other_parent, -1),
+        last_ancestors=np.concatenate(
+            [grid.last_ancestors, np.full((pad_e, n), -1, dtype=np.int32)]
+        ),
+        first_descendants=np.concatenate(
+            [grid.first_descendants, np.full((pad_e, n), MAX_INT32, dtype=np.int32)]
+        ),
+        coin_bit=pad1(grid.coin_bit, False),
+        fixed_round=pad1(grid.fixed_round, -1),
+        ext_sp_round=pad1(grid.ext_sp_round, -1),
+        ext_op_round=pad1(grid.ext_op_round, -1),
+        ext_sp_lamport=pad1(grid.ext_sp_lamport, -1),
+        ext_op_lamport=pad1(grid.ext_op_lamport, MIN_INT32),
+        fixed_lamport=pad1(grid.fixed_lamport, MIN_INT32),
+        levels=levels,
+        num_levels=l_b,
+        hashes=grid.hashes,
+    )
+
+
+def run_passes(
+    grid: DagGrid, d_max: Optional[int] = None, bucketed: bool = False
+) -> PassResults:
     """Run DivideRounds + DecideFame + DecideRoundReceived as one fused
     XLA program — no host synchronization between passes (last_round is
-    computed on device; the fame loop early-exits on device)."""
+    computed on device; the fame loop early-exits on device).
+
+    With bucketed=True, shapes are padded to a power-of-two schedule so a
+    growing live DAG triggers only O(log E) recompiles."""
     import jax
 
-    r_max = grid.r_max
+    e_real = grid.e
+    if bucketed:
+        grid = pad_grid(grid)
+        # round the round axis as well: r_base (post-reset anchor rounds)
+        # would otherwise mint a fresh static shape per reset
+        r_max = _bucket(grid.r_max, 64, factor=2)
+    else:
+        r_max = grid.r_max
     # the fame offset loop is self-bounding (j <= last_round < r_max);
     # d_cap is a static safety net only, so it never triggers recompiles
     d_cap = d_max if d_max is not None else r_max + 2
@@ -60,6 +130,7 @@ def run_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
         grid.fixed_round,
         grid.ext_sp_lamport,
         grid.ext_op_lamport,
+        grid.fixed_lamport,
         grid.coin_bit,
         grid.super_majority,
         grid.n,
@@ -69,14 +140,14 @@ def run_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
     host = jax.device_get(res)  # one batched transfer
 
     return PassResults(
-        rounds=host.rounds,
-        witness=host.witness,
-        lamport=host.lamport,
+        rounds=host.rounds[:e_real],
+        witness=host.witness[:e_real],
+        lamport=host.lamport[:e_real],
         witness_table=host.witness_table,
         fame_decided=host.fame_decided,
         famous=host.famous,
         rounds_decided=host.rounds_decided,
-        received=host.received,
+        received=host.received[:e_real],
         last_round=int(host.last_round),
     )
 
@@ -97,7 +168,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
         hg.process_decided_rounds()
         hg.process_sig_pool()
         return
-    res = run_passes(grid, d_max=d_max)
+    res = run_passes(grid, d_max=d_max, bucketed=True)
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
     undetermined = set(hg.undetermined_events)

@@ -57,6 +57,7 @@ class DagGrid:
     ext_op_round: np.ndarray  # (E,) int32 other-parent round outside grid (-1 none)
     ext_sp_lamport: np.ndarray  # (E,) int32
     ext_op_lamport: np.ndarray  # (E,) int32 (MIN_INT32 = none)
+    fixed_lamport: np.ndarray  # (E,) int32: != MIN_INT32 forces the lamport
     levels: np.ndarray  # (L, N) int32 event rows, -1 padding
     num_levels: int
     hashes: Optional[List[str]] = None  # row -> event hex (host bookkeeping)
@@ -103,10 +104,21 @@ def grid_from_hashgraph(hg) -> DagGrid:
     roots = {p.pub_key_hex: hg.store.get_root(p.pub_key_hex) for p in participants}
     roots_by_sp = hg.store.roots_by_self_parent()
 
+    from ..common import StoreErr
+
     events = []
-    for p in participants:
-        for h in hg.store.participant_events(p.pub_key_hex, -1):
-            events.append(hg.store.get_event(h))
+    try:
+        for p in participants:
+            # post-reset stores hold no history below the root: enumerate
+            # from the root's self-parent index, not from the beginning of
+            # time (a rolled/reset RollingIndex raises TooLate on skip=-1)
+            skip = roots[p.pub_key_hex].self_parent.index
+            for h in hg.store.participant_events(p.pub_key_hex, skip):
+                events.append(hg.store.get_event(h))
+    except StoreErr as err:
+        # a rolled cache window means part of the history is no longer
+        # reachable as full events — the dense full-DAG grid can't be built
+        raise GridUnsupported(f"store window rolled: {err}") from err
     events.sort(key=lambda ev: ev.topological_index)
 
     e_count = len(events)
@@ -124,6 +136,7 @@ def grid_from_hashgraph(hg) -> DagGrid:
     ext_op_round = np.full(e_count, -1, dtype=np.int32)
     ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
     ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+    fixed_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
     hashes = [ev.hex() for ev in events]
 
     for i, ev in enumerate(events):
@@ -158,8 +171,27 @@ def grid_from_hashgraph(hg) -> DagGrid:
                 ext_op_round[i] = opr.self_parent.round
                 # mirrors the host lamport cache-miss behavior for root
                 # self-parent hashes (hashgraph.py _lamport_once): stays MIN
+            elif op in hg.frozen_refs:
+                # other-parent below a fast-sync section cut: the FrozenRef
+                # carries its authoritative round. Lamport deliberately
+                # stays MIN — the host recursion consults only its memo
+                # cache and root `others` for lamports (hashgraph.py
+                # _lamport_once), so MIN is the bit-exact mirror; the
+                # section events that actually reference frozen refs carry
+                # pinned lamports anyway (fixed_lamport below).
+                ext_op_round[i] = hg.frozen_refs[op].round
             else:
                 raise GridUnsupported(f"other-parent unresolvable: {op[:18]}…")
+
+        # already-determined consensus metadata is authoritative, exactly
+        # like the host engine's memo caches (reference: hashgraph.go:36-40)
+        # — critically, post-reset it carries donor section state that a
+        # recompute from the amnesiac base could not reproduce (incomplete
+        # witness sets around the anchor)
+        if ev.round is not None:
+            fixed_round[i] = ev.round
+        if ev.lamport_timestamp is not None:
+            fixed_lamport[i] = ev.lamport_timestamp
 
         la[i] = [c[0] for c in ev.last_ancestors]
         fd[i] = [c[0] for c in ev.first_descendants]
@@ -183,6 +215,7 @@ def grid_from_hashgraph(hg) -> DagGrid:
         ext_op_round=ext_op_round,
         ext_sp_lamport=ext_sp_lamport,
         ext_op_lamport=ext_op_lamport,
+        fixed_lamport=fixed_lamport,
         levels=levels,
         num_levels=num_levels,
         hashes=hashes,
@@ -307,6 +340,7 @@ def synthetic_grid(
     ext_op_round = np.full(e_count, -1, dtype=np.int32)
     ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
     ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+    fixed_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
 
     return DagGrid(
         n=n,
@@ -324,6 +358,7 @@ def synthetic_grid(
         ext_op_round=ext_op_round,
         ext_sp_lamport=ext_sp_lamport,
         ext_op_lamport=ext_op_lamport,
+        fixed_lamport=fixed_lamport,
         levels=levels,
         num_levels=num_levels,
     )

@@ -77,6 +77,7 @@ class PipelineResult(NamedTuple):
 def _divide_rounds(
     levels, creator, index, self_parent, other_parent, la, fd,
     ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport, ext_op_lamport,
+    fixed_lamport,
     super_majority: int, r_max: int,
 ) -> DivideRoundsResult:
     e_count, n = la.shape
@@ -117,6 +118,10 @@ def _divide_rounds(
         sp_lt = jnp.where(sp >= 0, lamport[jnp.maximum(sp, 0)], ext_sp_lamport[rows])
         op_lt = jnp.where(op >= 0, lamport[jnp.maximum(op, 0)], ext_op_lamport[rows])
         new_lt = jnp.maximum(sp_lt, op_lt) + 1
+        # already-determined lamports are authoritative (host memo/stored
+        # metadata, incl. donor section state after a fast-sync)
+        fl = fixed_lamport[rows]
+        new_lt = jnp.where(fl != MIN_INT32, fl, new_lt)
 
         rounds = rounds.at[scatter_rows].set(new_round, mode="drop")
         lamport = lamport.at[scatter_rows].set(new_lt, mode="drop")
@@ -258,21 +263,16 @@ def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
     return min_la, famous_count, i_ok, horizon
 
 
-def _decide_round_received(
-    wtable, la, index, creator, rounds, decided, famous, rounds_decided,
-    last_round,
-) -> jax.Array:
-    """Round-received per event; -1 when still undetermined.
+def received_search(index, creator, rounds, min_la, famous_count, i_ok, horizon):
+    """The per-event round-received candidate search, shared verbatim by the
+    single-device pipeline and the events-sharded map (sharded.py):
 
     received(e) = min { i > round(e) : every round in (round(e), i] is
     fully fame-decided, round i has >= 1 famous witness, and all famous
     witnesses of i see e } (reference: hashgraph.go:951-1036).
     """
-    r_max, n = wtable.shape
-    min_la, famous_count, i_ok, horizon = _received_tables(
-        wtable, la, decided, famous, rounds_decided, last_round
-    )
-    idx = jnp.arange(r_max)
+    r_dim = min_la.shape[0]
+    idx = jnp.arange(r_dim)
 
     # candidate matrix (E, R): event e received at round i?
     seen_all = index[:, None] <= min_la[:, creator].T  # (E, R)
@@ -284,11 +284,24 @@ def _decide_round_received(
     )
     # prefix condition: every round in (rounds[e], i] decided ->
     # i < horizon[rounds[e]+1]
-    start = jnp.clip(rounds + 1, 0, r_max - 1)
+    start = jnp.clip(rounds + 1, 0, r_dim - 1)
     cand = cand & (idx[None, :] < horizon[start][:, None])
 
-    received = jnp.min(jnp.where(cand, idx[None, :], r_max), axis=1)
-    return jnp.where(received == r_max, -1, received).astype(jnp.int32)
+    received = jnp.min(jnp.where(cand, idx[None, :], r_dim), axis=1)
+    return jnp.where(received == r_dim, -1, received).astype(jnp.int32)
+
+
+def _decide_round_received(
+    wtable, la, index, creator, rounds, decided, famous, rounds_decided,
+    last_round,
+) -> jax.Array:
+    """Round-received per event; -1 when still undetermined."""
+    min_la, famous_count, i_ok, horizon = _received_tables(
+        wtable, la, decided, famous, rounds_decided, last_round
+    )
+    return received_search(
+        index, creator, rounds, min_la, famous_count, i_ok, horizon
+    )
 
 
 @functools.partial(
@@ -307,6 +320,7 @@ def consensus_pipeline(
     fixed_round: jax.Array,  # (E,) int32
     ext_sp_lamport: jax.Array,  # (E,) int32
     ext_op_lamport: jax.Array,  # (E,) int32
+    fixed_lamport: jax.Array,  # (E,) int32: != MIN forces the lamport
     coin_bit: jax.Array,  # (E,) bool
     super_majority: int,
     n_participants: int,
@@ -317,7 +331,7 @@ def consensus_pipeline(
     dr = _divide_rounds(
         levels, creator, index, self_parent, other_parent, la, fd,
         ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport,
-        ext_op_lamport, super_majority, r_max,
+        ext_op_lamport, fixed_lamport, super_majority, r_max,
     )
     last_round = jnp.max(dr.rounds)
     fame = _decide_fame(

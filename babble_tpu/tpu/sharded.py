@@ -27,7 +27,6 @@ tests/test_multichip.py on a virtual CPU mesh.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +36,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import kernels
 from .engine import PassResults
 from .grid import DagGrid
-from .kernels import MAX_INT32
 
 
 def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
@@ -134,19 +132,11 @@ def _received_fn(mesh: Mesh, axis: str):
 
     def local_received(index, creator, rounds, min_la, famous_count, i_ok,
                        horizon):
-        r_pad = min_la.shape[0]
-        idxr = jnp.arange(r_pad)
-        seen_all = index[:, None] <= min_la[:, creator].T  # (B, R)
-        cand = (
-            seen_all
-            & (famous_count[None, :] > 0)
-            & i_ok[None, :]
-            & (idxr[None, :] > rounds[:, None])
+        # the exact single-device candidate search, applied to the local
+        # event shard (fame tables replicated)
+        return kernels.received_search(
+            index, creator, rounds, min_la, famous_count, i_ok, horizon
         )
-        start = jnp.clip(rounds + 1, 0, r_pad - 1)
-        cand = cand & (idxr[None, :] < horizon[start][:, None])
-        received = jnp.min(jnp.where(cand, idxr[None, :], r_pad), axis=1)
-        return jnp.where(received == r_pad, -1, received).astype(jnp.int32)
 
     shp = P(axis)
     rep = P()
@@ -199,7 +189,8 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
         putr(grid.self_parent), putr(grid.other_parent), la, fd,
         putr(grid.ext_sp_round), putr(grid.ext_op_round),
         putr(grid.fixed_round), putr(grid.ext_sp_lamport),
-        putr(grid.ext_op_lamport), grid.super_majority, r_max,
+        putr(grid.ext_op_lamport), putr(grid.fixed_lamport),
+        grid.super_majority, r_max,
     )
     last_round = jnp.max(dr.rounds)
 

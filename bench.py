@@ -62,6 +62,7 @@ def load_grid():
             ext_op_round=np.full(e, -1, dtype=np.int32),
             ext_sp_lamport=np.full(e, -1, dtype=np.int32),
             ext_op_lamport=np.full(e, MIN_INT32, dtype=np.int32),
+            fixed_lamport=np.full(e, MIN_INT32, dtype=np.int32),
             levels=levels,
             num_levels=num_levels,
         )
