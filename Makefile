@@ -1,0 +1,31 @@
+# babble-tpu build/dev targets (reference: makefile — glide/go build becomes
+# pytest/demo orchestration; there is nothing to compile).
+
+PY ?= python3
+N ?= 4
+
+.PHONY: test bench demo-conf demo demo-watch demo-bombard multichip version
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+multichip:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+demo-conf:
+	./demo/build-conf.sh $(N)
+
+demo: demo-conf
+	./demo/run-testnet.sh $(N)
+
+demo-watch:
+	./demo/watch.sh $(N)
+
+demo-bombard:
+	./demo/bombard.sh $(N)
+
+version:
+	$(PY) -m babble_tpu version

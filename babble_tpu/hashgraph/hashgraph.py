@@ -72,6 +72,9 @@ class Hashgraph:
         self.last_consensus_round: Optional[int] = None
         self.first_consensus_round: Optional[int] = None
         self.anchor_block: Optional[int] = None
+        # surfaced as the `round_events` stat; the reference declares this
+        # counter but never assigns it (src/hashgraph/hashgraph.go:27 is its
+        # only non-test mention), so staying 0 is bit-faithful parity
         self.last_committed_round_events = 0
         self.sig_pool: List[BlockSignature] = []
         self.consensus_transactions = 0
@@ -794,9 +797,11 @@ class Hashgraph:
     # fast-sync live section (beyond the reference — see section.py)
     # ------------------------------------------------------------------
 
-    def get_section(self, anchor_round: int) -> Section:
+    def get_section(self, anchor_round: int, anchor_block_index: int = -1) -> Section:
         """Donor side: everything decided or pending above the anchor cut.
-        Caller must hold the node's core lock so the snapshot is consistent."""
+        Caller must hold the node's core lock so the snapshot is consistent.
+        `anchor_block_index` keys the accumulated-signature proof for the
+        blocks above the anchor (verify_section on the joiner)."""
         last_consensus = (
             self.last_consensus_round
             if self.last_consensus_round is not None
@@ -854,7 +859,14 @@ class Hashgraph:
                 try:
                     ope = self.store.get_event(op)
                 except StoreErr:
-                    continue  # donor itself only has a ref — skip
+                    # a donor that itself fast-synced may hold only a ref —
+                    # forward it, or a joiner chaining off this donor cannot
+                    # resolve the other-parent and is stuck retrying
+                    ref = self.frozen_refs.get(op)
+                    if ref is not None:
+                        frozen_seen.add(op)
+                        frozen.append(ref)
+                    continue
                 frozen_seen.add(op)
                 frozen.append(
                     FrozenRef(
@@ -869,6 +881,16 @@ class Hashgraph:
         frames = [
             self.get_frame(r) for r in range(anchor_round + 1, last_consensus + 1)
         ]
+        # stored blocks (with accumulated validator signatures) for every
+        # block the joiner will replay from these frames — its proof the
+        # continuation is the network's chain, not this donor's invention
+        proof_blocks: Dict[int, Block] = {}
+        if anchor_block_index >= 0:
+            for i in range(anchor_block_index + 1, self.store.last_block_index() + 1):
+                try:
+                    proof_blocks[i] = self.store.get_block(i)
+                except StoreErr:
+                    continue
         base_meta = [
             FrozenRef(
                 hash=ev.hex(),
@@ -879,6 +901,26 @@ class Hashgraph:
             )
             for ev in frame.events
         ]
+
+        # last consensus event per participant AS OF the anchor round: walk
+        # each chain down from the donor's current last-consensus-event until
+        # round-received <= anchor. Frame roots for participants quiet since
+        # the anchor are built from exactly this event (get_frame), so the
+        # joiner must share it or its frame hashes diverge from the network.
+        consensus_baseline: Dict[str, str] = {}
+        for p in self.participants.to_pub_key_slice():
+            h, is_root = self.store.last_consensus_event_from(p)
+            while not is_root:
+                try:
+                    ev = self.store.get_event(h)
+                except StoreErr:
+                    h = ""
+                    break
+                if ev.round_received is not None and ev.round_received <= anchor_round:
+                    break
+                h = ev.self_parent()
+            if not is_root and h:
+                consensus_baseline[p] = h
         return Section(
             anchor_round=anchor_round,
             last_consensus_round=last_consensus,
@@ -887,7 +929,95 @@ class Hashgraph:
             frames=frames,
             frozen_refs=frozen,
             base_meta=base_meta,
+            proof_blocks=proof_blocks,
+            consensus_baseline=consensus_baseline,
         )
+
+    def verify_section(self, anchor_block: Block, section: Section) -> None:
+        """Joiner side, BEFORE any state is mutated: check that the chain
+        the section replays is the network's, not a single donor's
+        fabrication.
+
+        Every event must carry a valid creator signature. Every replayed
+        block must be endorsed by >1/3 of the validator set (the
+        check_block threshold): the donor ships its stored blocks as proof,
+        whose signatures cover the full body (index, round-received, state
+        hash, frame hash, txs) — so a proof block with enough valid
+        signatures whose identity fields match the frame we will replay
+        pins that frame to the network's chain.
+
+        Residual trust window, stated honestly: the freshest two rounds are
+        exempt from the proof requirement, because a block's signatures
+        ride self-events of strictly later rounds and cannot have
+        propagated yet. A donor therefore gets an optimistic window of at
+        most two replayed rounds whose ordering is its word alone — the
+        same post-anchor trust the reference extends when re-deciding from
+        donor-gossiped data — and forging even that window requires a
+        malicious *validator* (events are signature-checked, so frame
+        contents must be real validator events). Everything deeper must be
+        proven or the sync is rejected; a donor that truncates its section
+        to stay inside the window only delays the joiner, which picks up
+        the rest through ordinary gossip."""
+        for ev in section.events:
+            if not ev.verify():
+                raise ValueError("Invalid Event signature in fast-sync section")
+
+        sig_lag_floor = (
+            max(f.round for f in section.frames) - 2 if section.frames else -1
+        )
+        # replicate process_decided_rounds' index assignment: ascending
+        # frames, empty frames produce no block
+        next_index = anchor_block.index() + 1
+        for frame in section.frames:
+            if not frame.events:
+                continue
+            proof = section.proof_blocks.get(next_index)
+            valid = 0
+            if (
+                proof is not None
+                and proof.index() == next_index
+                and proof.round_received() == frame.round
+                and proof.frame_hash() == frame.hash()
+            ):
+                valid = self.valid_signature_count(proof)
+            if valid <= self.trust_count and frame.round <= sig_lag_floor:
+                raise ValueError(
+                    f"fast-sync section: replayed block {next_index} "
+                    f"(round {frame.round}) has {valid} valid signatures, "
+                    f"need {self.trust_count + 1}"
+                )
+            next_index += 1
+
+        self._verify_consensus_baseline(section)
+
+    def _verify_consensus_baseline(self, section: Section) -> None:
+        """The baseline hashes seed future frame-root construction
+        (apply_section), so each must identify a shipped, signature-checked
+        event of the claimed participant that was received at or below the
+        anchor — a fabricated hash would fork every later frame the joiner
+        builds."""
+        known: Dict[str, Event] = {ev.hex(): ev for ev in section.events}
+        for f in section.frames:
+            for ev in f.events:
+                known[ev.hex()] = ev
+        base_hashes = {fr.hash for fr in section.base_meta}
+        for p, h in section.consensus_baseline.items():
+            ev = known.get(h)
+            if ev is None:
+                if h in base_hashes:
+                    continue  # anchor-frame event, already pinned + checked
+                raise ValueError(
+                    "fast-sync section: consensus baseline references an "
+                    "unknown event"
+                )
+            if ev.creator() != p:
+                raise ValueError(
+                    "fast-sync section: consensus baseline creator mismatch"
+                )
+            if ev.round_received is not None and ev.round_received > section.anchor_round:
+                raise ValueError(
+                    "fast-sync section: consensus baseline above the anchor"
+                )
 
     def apply_section(self, section: Section) -> None:
         """Joiner side: replay the donor's decided state above the anchor.
@@ -904,6 +1034,12 @@ class Hashgraph:
         self.reset_floor = section.anchor_round
 
         self.frozen_refs.update({fr.hash: fr for fr in section.frozen_refs})
+        # adopt the donor's last-consensus-event baseline: the anchor round
+        # itself is never replayed (it is settled by the frame), so without
+        # this the joiner's frame roots for participants quiet since the
+        # anchor would be built from a different event than the network's
+        for p, h in section.consensus_baseline.items():
+            self.store.seed_last_consensus_event(p, h)
         # pin the anchor frame events' consensus metadata so nothing here
         # recomputes it from the amnesiac base
         for fr in section.base_meta:
@@ -923,9 +1059,10 @@ class Hashgraph:
             ri.queued = True  # pending status is tracked below
             self.store.set_round(r, ri)
 
+        # event signatures were checked by verify_section (fast_forward
+        # always validates before applying); re-verifying here would double
+        # the dominant ECDSA cost of catch-up
         for ev in section.events:
-            if not ev.verify():
-                raise ValueError("Invalid Event signature in fast-sync section")
             self._check_self_parent(ev)
             self._check_other_parent(ev)
             ev.topological_index = self.topological_index
@@ -1010,9 +1147,19 @@ class Hashgraph:
         )
         return event
 
+    def valid_signature_count(self, block: Block) -> int:
+        """Signatures that are both cryptographically valid AND from a
+        member of the validator set — a signature from any other key proves
+        nothing (process_sig_pool applies the same membership filter)."""
+        return sum(
+            1
+            for s in block.get_signatures()
+            if s.validator_hex() in self.participants.by_pub_key and block.verify(s)
+        )
+
     def check_block(self, block: Block) -> None:
         """Valid iff strictly more than 1/3 of participants signed."""
-        valid = sum(1 for s in block.get_signatures() if block.verify(s))
+        valid = self.valid_signature_count(block)
         if valid <= self.trust_count:
             raise ValueError(
                 f"Not enough valid signatures: got {valid}, need {self.trust_count + 1}"

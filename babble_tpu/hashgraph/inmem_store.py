@@ -118,6 +118,13 @@ class InmemStore(Store):
         self.tot_consensus_events += 1
         self.last_consensus_events[event.creator()] = event.hex()
 
+    def seed_last_consensus_event(self, participant: str, event_hex: str) -> None:
+        """Fast-sync: install the donor's last-consensus-event baseline for a
+        participant without counting it as a locally processed event. Frame
+        roots for participants quiet since the anchor are built from this
+        (get_frame), so it must match the rest of the network exactly."""
+        self.last_consensus_events[participant] = event_hex
+
     def get_round(self, r: int) -> RoundInfo:
         res, ok = self.round_cache.get(r)
         if not ok:

@@ -39,6 +39,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List
 
+from .block import Block
 from .event import Event
 from .frame import Frame
 from .round_info import RoundInfo
@@ -90,6 +91,19 @@ class Section:
     # frame roots that reference them diverge (the Frame wire format itself
     # cannot carry this — its hash is pinned in the anchor block)
     base_meta: List[FrozenRef] = field(default_factory=list)
+    # the donor's stored blocks (with their accumulated validator
+    # signatures) per replayed block index: proof material that lets the
+    # joiner verify the replayed chain against >1/3 of the validator set
+    # before committing anything (Hashgraph.verify_section) — the
+    # signatures cover the full block body (index, round, state hash,
+    # frame hash, txs), so they must travel with the body they signed
+    proof_blocks: Dict[int, Block] = field(default_factory=dict)
+    # participant pubkey -> last consensus event hash as of the anchor
+    # round: seeds the joiner's last-consensus-event bookkeeping so frame
+    # roots for participants quiet since the anchor are built from the
+    # same event on every node (divergent roots change the frame hash and
+    # break block byte-equality)
+    consensus_baseline: Dict[str, str] = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -100,6 +114,10 @@ class Section:
             "Frames": [f.to_json() for f in self.frames],
             "FrozenRefs": [fr.to_json() for fr in self.frozen_refs],
             "BaseMeta": [fr.to_json() for fr in self.base_meta],
+            "ProofBlocks": {
+                str(i): b.to_json() for i, b in self.proof_blocks.items()
+            },
+            "ConsensusBaseline": dict(sorted(self.consensus_baseline.items())),
         }
 
     @classmethod
@@ -117,4 +135,9 @@ class Section:
                 FrozenRef.from_json(fr) for fr in d.get("FrozenRefs", [])
             ],
             base_meta=[FrozenRef.from_json(fr) for fr in d.get("BaseMeta", [])],
+            proof_blocks={
+                int(i): Block.from_json(b)
+                for i, b in d.get("ProofBlocks", {}).items()
+            },
+            consensus_baseline=dict(d.get("ConsensusBaseline", {})),
         )

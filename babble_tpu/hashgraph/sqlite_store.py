@@ -228,6 +228,9 @@ class SQLiteStore(Store):
     def add_consensus_event(self, event: Event) -> None:
         self.inmem.add_consensus_event(event)
 
+    def seed_last_consensus_event(self, participant: str, event_hex: str) -> None:
+        self.inmem.seed_last_consensus_event(participant, event_hex)
+
     def get_round(self, r: int) -> RoundInfo:
         try:
             return self.inmem.get_round(r)

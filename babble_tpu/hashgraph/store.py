@@ -54,6 +54,11 @@ class Store(ABC):
     def add_consensus_event(self, event: Event) -> None: ...
 
     @abstractmethod
+    def seed_last_consensus_event(self, participant: str, event_hex: str) -> None:
+        """Install a fast-sync baseline for last_consensus_event_from
+        without counting a locally processed event (Hashgraph.apply_section)."""
+
+    @abstractmethod
     def get_round(self, r: int) -> RoundInfo: ...
 
     @abstractmethod

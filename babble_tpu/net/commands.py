@@ -85,17 +85,13 @@ class EagerSyncResponse:
 @dataclass
 class FastForwardRequest:
     from_id: int
-    # last block the requester holds: the responder ships its own blocks
-    # for the gap (low_block, anchor) so blocks the requester committed
-    # mid-catch-up (on a pre-reset timeline) are reconciled with the chain
-    low_block: int = -1
 
     def to_json(self) -> dict:
-        return {"FromID": self.from_id, "LowBlock": self.low_block}
+        return {"FromID": self.from_id}
 
     @classmethod
     def from_json(cls, d: dict) -> "FastForwardRequest":
-        return cls(from_id=d["FromID"], low_block=d.get("LowBlock", -1))
+        return cls(from_id=d["FromID"])
 
 
 @dataclass
@@ -105,7 +101,6 @@ class FastForwardResponse:
     frame: Optional[Frame] = None
     snapshot: bytes = b""
     section: Optional[Section] = None
-    gap_blocks: List[Block] = field(default_factory=list)
 
     def to_json(self) -> dict:
         from ..utils.codec import b64e
@@ -116,7 +111,6 @@ class FastForwardResponse:
             "Frame": self.frame.to_json() if self.frame is not None else None,
             "Snapshot": b64e(self.snapshot),
             "Section": self.section.to_json() if self.section is not None else None,
-            "GapBlocks": [b.to_json() for b in self.gap_blocks],
         }
 
     @classmethod
@@ -129,5 +123,4 @@ class FastForwardResponse:
             frame=Frame.from_json(d["Frame"]) if d.get("Frame") else None,
             snapshot=b64d(d.get("Snapshot", "")),
             section=Section.from_json(d["Section"]) if d.get("Section") else None,
-            gap_blocks=[Block.from_json(b) for b in d.get("GapBlocks", [])],
         )

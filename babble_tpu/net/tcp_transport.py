@@ -66,7 +66,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket, max_len: int = 1 << 30):
+# sync payloads are sync-limit-bounded event batches; fast-forward responses
+# carry a frame + section + app snapshot. 64 MiB covers both with wide margin
+# while keeping an unauthenticated peer from staging gigabyte buffers.
+DEFAULT_MAX_FRAME = 64 << 20
+
+
+def _recv_frame(sock: socket.socket, max_len: int = DEFAULT_MAX_FRAME):
     tag, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if length > max_len:
         raise TransportError(f"frame too large: {length}")
@@ -87,6 +93,8 @@ class TCPTransport(Transport):
         max_pool: int = 2,
         timeout: float = 2.0,
         advertise: Optional[str] = None,
+        max_frame_size: int = DEFAULT_MAX_FRAME,
+        max_inbound: int = 64,
     ):
         host, port = split_hostport(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -103,6 +111,8 @@ class TCPTransport(Transport):
 
         self.max_pool = max_pool
         self.timeout = timeout
+        self.max_frame_size = max_frame_size
+        self.max_inbound = max_inbound
         self._consumer: "queue.Queue[RPC]" = queue.Queue()
         self._pool: Dict[str, List[socket.socket]] = {}
         self._pool_lock = threading.Lock()
@@ -189,8 +199,8 @@ class TCPTransport(Transport):
             conn.settimeout(self.timeout)
             body = json.dumps(req.to_json()).encode()
             _send_frame(conn, tag, body)
-            status, payload = _recv_frame(conn)
-        except (OSError, ConnectionError) as exc:
+            status, payload = _recv_frame(conn, self.max_frame_size)
+        except (OSError, ConnectionError, TransportError) as exc:
             try:
                 conn.close()
             except OSError:
@@ -212,6 +222,14 @@ class TCPTransport(Transport):
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._pool_lock:
+                # each inbound conn owns a handler thread; cap both so an
+                # unauthenticated flood cannot exhaust memory/threads
+                if len(self._inbound) >= self.max_inbound:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
                 self._inbound.append(sock)
             threading.Thread(
                 target=self._handle_conn, args=(sock,), daemon=True
@@ -220,7 +238,7 @@ class TCPTransport(Transport):
     def _handle_conn(self, sock: socket.socket) -> None:
         try:
             while not self._shutdown.is_set():
-                tag, body = _recv_frame(sock)
+                tag, body = _recv_frame(sock, self.max_frame_size)
                 req_type = _REQ_TYPES.get(tag)
                 if req_type is None:
                     _send_frame(sock, 1, f"unknown rpc tag {tag}".encode())
@@ -239,7 +257,7 @@ class TCPTransport(Transport):
                     _send_frame(
                         sock, 0, json.dumps(resp.response.to_json()).encode()
                     )
-        except (ConnectionError, OSError, json.JSONDecodeError):
+        except (ConnectionError, OSError, json.JSONDecodeError, TransportError):
             pass
         finally:
             try:

@@ -146,18 +146,23 @@ class Core:
                 other_head = ev.hex()
         self.add_self_event(other_head)
 
-    def fast_forward(
-        self, peer: str, block: Block, frame: Frame, section=None
-    ) -> None:
-        # Deep-copy through the wire codec: over the in-process transport the
-        # block/frame/section share mutable state with the responder's store,
-        # and the frame events carry the responder's cached round/lamport/
-        # coordinate metadata — it must be stripped so Reset recomputes it
-        # against the new roots (the Go reference gets this for free from
-        # value+codec semantics at the RPC boundary; with live objects, stale
-        # ev.round makes DivideRounds skip witness registration and consensus
-        # stalls). The section's metadata, by contrast, is deliberately
-        # carried in its wire form (see hashgraph/section.py).
+    def prepare_fast_forward(
+        self, block: Block, frame: Frame, section=None
+    ) -> Tuple[Block, Frame, object]:
+        """Validate a fast-forward response WITHOUT mutating any state —
+        the node restores the app snapshot only after this passes, so a bad
+        donor can never leave the app rolled onto a foreign snapshot.
+
+        Deep-copies through the wire codec: over the in-process transport
+        the block/frame/section share mutable state with the responder's
+        store, and the frame events carry the responder's cached round/
+        lamport/coordinate metadata — it must be stripped so Reset
+        recomputes it against the new roots (the Go reference gets this for
+        free from value+codec semantics at the RPC boundary; with live
+        objects, stale ev.round makes DivideRounds skip witness
+        registration and consensus stalls). The section's metadata, by
+        contrast, is deliberately carried in its wire form (see
+        hashgraph/section.py)."""
         from ..hashgraph import Section
 
         block = Block.from_json(block.to_json())
@@ -167,12 +172,24 @@ class Core:
         self.hg.check_block(block)
         if block.frame_hash() != frame.hash():
             raise ValueError("Invalid Frame Hash")
+        if section is not None:
+            self.hg.verify_section(block, section)
+        return block, frame, section
+
+    def apply_fast_forward(self, block: Block, frame: Frame, section=None) -> None:
+        """Apply a validated fast-forward (reset + section replay +
+        consensus continuation). Args must come from prepare_fast_forward."""
         self.hg.reset(block, frame)
         if section is not None:
             self.hg.apply_section(section)
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
         self.run_consensus()
+
+    def fast_forward(
+        self, peer: str, block: Block, frame: Frame, section=None
+    ) -> None:
+        self.apply_fast_forward(*self.prepare_fast_forward(block, frame, section))
 
     def add_self_event(self, other_head: str) -> None:
         if (

@@ -250,7 +250,7 @@ class Node(NodeStateMachine):
             with self.core_lock:
                 # anchor + live section must come from one consistent snapshot
                 block, frame = self.core.get_anchor_block_with_frame()
-                section = self.core.hg.get_section(frame.round)
+                section = self.core.hg.get_section(frame.round, block.index())
             resp.block = block
             resp.frame = frame
             resp.section = section
@@ -326,14 +326,39 @@ class Node(NodeStateMachine):
             resp = self.trans.fast_forward(
                 peer.net_addr, FastForwardRequest(from_id=self.id)
             )
-            # restore the app BEFORE core.fast_forward: applying the section
-            # replays blocks above the anchor through the commit channel, and
-            # those commits must land on the restored snapshot state
-            self.proxy.restore(resp.snapshot)
+            # validate first (no state mutated), THEN restore the app, THEN
+            # apply: the restore must precede the apply because the section
+            # replays blocks above the anchor through the commit channel
+            # onto the restored snapshot state — but it must follow
+            # validation so a bad donor can't leave the app on a foreign
+            # snapshot with the hashgraph unchanged
             with self.core_lock:
-                self.core.fast_forward(
-                    peer.pub_key_hex, resp.block, resp.frame, resp.section
+                validated = self.core.prepare_fast_forward(
+                    resp.block, resp.frame, resp.section
                 )
+            # the anchor block's state hash is covered by its >1/3 validator
+            # signatures (check_block in prepare) — the restored snapshot
+            # must reproduce it, or the donor sent a forged snapshot. The
+            # hash can only be computed by the app itself, so the check
+            # necessarily runs after the restore; on mismatch we roll the
+            # app back to its pre-restore state (best effort — a fresh
+            # joiner has nothing to roll back to).
+            rollback = None
+            last_block = self.core.get_last_block_index()
+            if last_block >= 0:
+                try:
+                    rollback = self.proxy.get_snapshot(last_block)
+                except Exception:  # noqa: BLE001 — app may not have one
+                    rollback = None
+            restored_hash = self.proxy.restore(resp.snapshot)
+            if restored_hash != validated[0].state_hash():
+                if rollback is not None:
+                    self.proxy.restore(rollback)
+                raise ValueError(
+                    "snapshot state hash does not match the signed anchor block"
+                )
+            with self.core_lock:
+                self.core.apply_fast_forward(*validated)
         except Exception as e:
             self.logger.error("fast_forward: %s", e)
             time.sleep(self.conf.heartbeat_timeout)

@@ -1,0 +1,77 @@
+"""HTTP status service: `GET /stats` and `GET /block/{index}`
+(reference: src/service/service.go:28-63).
+
+Runs a daemon ThreadingHTTPServer so `serve()` mirrors the reference's
+`go Service.Serve()` composition (babble.go:203-209) without blocking the
+node loops.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .utils.netaddr import split_hostport
+
+
+class Service:
+    def __init__(self, bind_address: str, node, logger: Optional[logging.Logger] = None):
+        self.bind_address = bind_address
+        self.node = node
+        self.logger = logger or logging.getLogger("babble.service")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def serve(self) -> None:
+        """Start serving in a background thread (idempotent)."""
+        if self._httpd is not None:
+            return
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                try:
+                    if self.path == "/stats":
+                        body = json.dumps(service.node.get_stats()).encode()
+                    elif self.path.startswith("/block/"):
+                        index = int(self.path[len("/block/"):])
+                        body = json.dumps(
+                            service.node.get_block(index).to_json()
+                        ).encode()
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — surface as HTTP 500
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                service.logger.debug("service: " + fmt, *args)
+
+        host, port = split_hostport(self.bind_address)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="babble-service", daemon=True
+        )
+        self._thread.start()
+        self.logger.debug("Service serving on %s", self.local_addr())
+
+    def local_addr(self) -> str:
+        if self._httpd is None:
+            return self.bind_address
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Fire a burst of transactions at random nodes' proxy listeners
+(reference: demo/scripts/bombard.sh). Uses the same app->babble JSON-RPC
+verb the socket clients use (Babble.SubmitTx).
+
+    python3 demo/bombard.py --nodes 4 --count 200
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from babble_tpu.proxy.jsonrpc import JSONRPCClient  # noqa: E402
+from babble_tpu.utils.codec import b64e  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--count", type=int, default=100)
+    p.add_argument("--base-port", type=int, default=1338,
+                   help="proxy-listen of node0; node i is base+10*i")
+    args = p.parse_args()
+
+    clients = [
+        JSONRPCClient(f"127.0.0.1:{args.base_port + 10 * i}", timeout=2.0)
+        for i in range(args.nodes)
+    ]
+    sent = 0
+    for k in range(args.count):
+        c = random.choice(clients)
+        try:
+            c.call("Babble.SubmitTx", b64e(f"bombard tx {k}".encode()))
+            sent += 1
+        except Exception as e:  # noqa: BLE001
+            print(f"submit {k} failed: {e}", file=sys.stderr)
+    print(f"submitted {sent}/{args.count} transactions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

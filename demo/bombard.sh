@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Burst extra transactions at random nodes (reference: demo/scripts/bombard.sh).
+set -euo pipefail
+N=${1:-4}
+COUNT=${2:-200}
+exec python3 "$(dirname "$0")/bombard.py" --nodes "$N" --count "$COUNT"

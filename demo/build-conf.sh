@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Build a localhost testnet configuration: one datadir per node with
+# priv_key.pem + a shared peers.json
+# (reference: demo/scripts/build-conf.sh — docker IPs become localhost ports).
+set -euo pipefail
+
+N=${1:-4}
+CONF=${CONF:-/tmp/babble-tpu-demo}
+PY=${PY:-python3}
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+
+rm -rf "$CONF"
+mkdir -p "$CONF"
+
+PEERS="["
+for i in $(seq 0 $((N - 1))); do
+  DATADIR="$CONF/node$i"
+  mkdir -p "$DATADIR"
+  PUB=$(cd "$REPO" && $PY -m babble_tpu keygen --datadir "$DATADIR" | sed -n 's/^Public Key: //p')
+  PORT=$((1337 + i * 10))
+  [ "$i" -gt 0 ] && PEERS+=","
+  PEERS+="{\"NetAddr\":\"127.0.0.1:$PORT\",\"PubKeyHex\":\"$PUB\"}"
+done
+PEERS+="]"
+
+for i in $(seq 0 $((N - 1))); do
+  echo "$PEERS" >"$CONF/node$i/peers.json"
+done
+
+echo "Configuration for $N nodes written under $CONF"

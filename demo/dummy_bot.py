@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Demo app bot: the app side of one node's socket proxy split. Handles
+CommitBlock/Snapshot/Restore like the chat client, and (optionally)
+submits a steady trickle of transactions so the testnet makes blocks
+(the role the reference demo gives its dummy containers + bombard.sh).
+
+    python3 demo/dummy_bot.py --name node0 \
+        --client-listen 127.0.0.1:1339 --proxy-connect 127.0.0.1:1338 --rate 5
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from babble_tpu.proxy import DummySocketClient  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", default="bot")
+    p.add_argument("--client-listen", required=True)
+    p.add_argument("--proxy-connect", required=True)
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="transactions per second to submit (0 = commit-only)")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.WARNING)
+    client = DummySocketClient(
+        node_addr=args.proxy_connect,
+        bind_addr=args.client_listen,
+        logger=logging.getLogger(args.name),
+    )
+
+    n = 0
+    while True:
+        if args.rate > 0:
+            try:
+                client.submit_tx(f"{args.name} tx {n}".encode())
+                n += 1
+            except Exception as e:  # noqa: BLE001 — node may still be starting
+                print(f"{args.name}: submit failed: {e}", file=sys.stderr)
+            time.sleep(1.0 / args.rate)
+        else:
+            time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

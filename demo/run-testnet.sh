@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Launch the localhost testnet built by build-conf.sh: N nodes over the
+# socket proxy split, each with a dummy app bot that commits blocks and
+# trickles transactions (reference: demo/scripts/run-testnet.sh —
+# heartbeat 10ms, timeout 200ms, cache-size 50000).
+set -euo pipefail
+
+N=${1:-4}
+CONF=${CONF:-/tmp/babble-tpu-demo}
+PY=${PY:-python3}
+BACKEND=${BACKEND:-cpu}
+RATE=${RATE:-5}
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+for i in $(seq 0 $((N - 1))); do
+  PORT=$((1337 + i * 10))
+  PROXY=$((1338 + i * 10))
+  CLIENT=$((1339 + i * 10))
+  SERVICE=$((8000 + i))
+  # app bot first: the node dials the client at startup
+  $PY "$REPO/demo/dummy_bot.py" --name "node$i" \
+    --client-listen "127.0.0.1:$CLIENT" --proxy-connect "127.0.0.1:$PROXY" \
+    --rate "$RATE" >"$CONF/node$i/bot.log" 2>&1 &
+  pids+=($!)
+  (cd "$REPO" && exec $PY -m babble_tpu run \
+    --datadir "$CONF/node$i" \
+    --listen "127.0.0.1:$PORT" \
+    --proxy-listen "127.0.0.1:$PROXY" \
+    --client-connect "127.0.0.1:$CLIENT" \
+    --service-listen "127.0.0.1:$SERVICE" \
+    --heartbeat 0.01 --timeout 0.2 --cache-size 50000 --sync-limit 500 \
+    --consensus-backend "$BACKEND" \
+    --log warn) >"$CONF/node$i/log" 2>&1 &
+  pids+=($!)
+done
+
+echo "testnet up: nodes on 1337/1347/..., /stats on http://127.0.0.1:800{0..$((N - 1))}"
+echo "Ctrl-C to stop; logs under $CONF/node*/log"
+wait

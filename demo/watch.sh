@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Poll every node's /stats (reference: demo/scripts/watch.sh).
+set -euo pipefail
+N=${1:-4}
+while true; do
+  clear 2>/dev/null || true
+  for i in $(seq 0 $((N - 1))); do
+    echo "--- node$i (127.0.0.1:$((8000 + i))) ---"
+    curl -s -m 1 "http://127.0.0.1:$((8000 + i))/stats" || echo "(unreachable)"
+    echo
+  done
+  sleep 1
+done

@@ -252,3 +252,105 @@ def test_core_fast_forward_then_keep_syncing():
             cores[0].hg.store.get_block(bi).body.marshal()
             == lagging.hg.store.get_block(bi).body.marshal()
         )
+
+
+def test_verify_section_rejects_forged_continuation():
+    """A single malicious donor must not be able to feed a joiner a
+    fabricated consensus continuation: every replayed block outside the
+    signature-propagation lag window needs >1/3 valid validator signatures
+    (Hashgraph.verify_section)."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 5:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 1500, "3-core playbook failed to make blocks"
+
+    # accumulate validator signatures on the donor's stored blocks — in a
+    # live node process_sig_pool does this from gossiped signatures
+    for bi in range(1, cores[0].get_last_block_index() + 1):
+        blk = cores[0].hg.store.get_block(bi)
+        for c in cores[:3]:
+            blk.set_signature(blk.sign(c.key))
+        cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+    section = cores[0].hg.get_section(frame.round, block.index())
+
+    def fresh_joiner():
+        return Core(
+            3, cores[3].key, cores[0].participants,
+            InmemStore(cores[0].participants, 1000), None,
+        )
+
+    # the honest section passes
+    fresh_joiner().fast_forward(cores[0].hex_id(), block, frame, section)
+
+    # tampered continuation: forge a transaction inside the earliest
+    # replayed frame — the donor's accumulated signatures no longer match
+    # the rebuilt block body
+    from babble_tpu.hashgraph import Section
+
+    forged = Section.from_json(section.to_json())
+    target = forged.frames[0]
+    assert target.events, "first replayed frame unexpectedly empty"
+    target.events[0].body.transactions = [b"forged tx"]
+    with pytest.raises(ValueError):
+        fresh_joiner().fast_forward(cores[0].hex_id(), block, frame, forged)
+
+    # a continuation with its signature proof stripped must also fail for
+    # frames old enough that signatures must have propagated
+    stripped = Section.from_json(section.to_json())
+    stripped.proof_blocks = {}
+    with pytest.raises(ValueError):
+        fresh_joiner().fast_forward(cores[0].hex_id(), block, frame, stripped)
+
+
+def test_verify_section_rejects_non_validator_signatures():
+    """Signatures from keys outside the validator set prove nothing: a
+    donor forging frames + proof blocks signed by throwaway keys must be
+    rejected (both by verify_section and check_block)."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 5:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 1500, "3-core playbook failed to make blocks"
+
+    for bi in range(1, cores[0].get_last_block_index() + 1):
+        blk = cores[0].hg.store.get_block(bi)
+        for c in cores[:3]:
+            blk.set_signature(blk.sign(c.key))
+        cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+    section = cores[0].hg.get_section(frame.round, block.index())
+
+    # replace every proof block's signatures with ones from throwaway keys
+    from babble_tpu.hashgraph import Section
+
+    forged = Section.from_json(section.to_json())
+    attackers = [generate_key() for _ in range(3)]
+    for pb in forged.proof_blocks.values():
+        pb.signatures.clear()
+        for k in attackers:
+            pb.set_signature(pb.sign(k))
+
+    joiner = Core(
+        3, cores[3].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    with pytest.raises(ValueError):
+        joiner.fast_forward(cores[0].hex_id(), block, frame, forged)
+
+    # check_block: an anchor signed only by outsiders must fail too
+    from babble_tpu.hashgraph import Block
+
+    fake_anchor = Block.from_json(block.to_json())
+    fake_anchor.signatures.clear()
+    for k in attackers:
+        fake_anchor.set_signature(fake_anchor.sign(k))
+    with pytest.raises(ValueError):
+        joiner.hg.check_block(fake_anchor)

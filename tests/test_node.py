@@ -113,7 +113,9 @@ def check_gossip(nodes, from_block=0, upto=None):
         for node in nodes[1:]:
             other = node.get_block(i)
             assert other.body.marshal() == ref.body.marshal(), (
-                f"block {i} differs between node {nodes[0].id} and node {node.id}"
+                f"block {i} differs between node {nodes[0].id} and node "
+                f"{node.id}:\n  {ref.body.marshal()!r}\n  vs\n"
+                f"  {other.body.marshal()!r}"
             )
 
 
