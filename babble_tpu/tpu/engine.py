@@ -23,7 +23,12 @@ from . import kernels
 
 @dataclass
 class PassResults:
-    """Device results staged back to host numpy."""
+    """Device results staged back to host numpy.
+
+    rounds/received/last_round are in absolute round numbers; the (R, N)
+    tables are indexed by round - round_offset (rebasing keeps the device
+    round axis proportional to activity since the last reset, not to the
+    node's lifetime)."""
 
     rounds: np.ndarray  # (E,)
     witness: np.ndarray  # (E,)
@@ -34,6 +39,7 @@ class PassResults:
     rounds_decided: np.ndarray  # (R,)
     received: np.ndarray  # (E,)
     last_round: int
+    round_offset: int = 0
 
 
 def _bucket(x: int, floor: int, factor: int = 4) -> int:
@@ -94,61 +100,130 @@ def pad_grid(grid: DagGrid) -> DagGrid:
     )
 
 
+def rebase_rounds(grid: DagGrid):
+    """Shift all externally-supplied round numbers down by their minimum so
+    the device round axis spans activity since the last reset, not the
+    node's lifetime (round numbers only ever grow; without this a
+    long-lived node's fame tensors would scale with historical rounds)."""
+    import dataclasses
+
+    lows = [
+        a[a >= 0]
+        for a in (grid.fixed_round, grid.ext_sp_round, grid.ext_op_round)
+    ]
+    lows = [a for a in lows if a.size]
+    if not lows:
+        return grid, 0
+    r_lo = int(min(a.min() for a in lows))
+    if r_lo <= 0:
+        return grid, 0
+
+    def shift(a):
+        return np.where(a >= 0, a - r_lo, a).astype(np.int32)
+
+    return (
+        dataclasses.replace(
+            grid,
+            fixed_round=shift(grid.fixed_round),
+            ext_sp_round=shift(grid.ext_sp_round),
+            ext_op_round=shift(grid.ext_op_round),
+        ),
+        r_lo,
+    )
+
+
+# grow-only hint for the adaptive fame/received round axis, shared by all
+# engines in the process (a wrong hint costs one discarded run, then sticks)
+_r_fame_hint = 8
+
+
 def run_passes(
-    grid: DagGrid, d_max: Optional[int] = None, bucketed: bool = False
+    grid: DagGrid,
+    d_max: Optional[int] = None,
+    bucketed: bool = False,
+    adaptive_r: bool = False,
 ) -> PassResults:
     """Run DivideRounds + DecideFame + DecideRoundReceived as one fused
     XLA program — no host synchronization between passes (last_round is
     computed on device; the fame loop early-exits on device).
 
     With bucketed=True, shapes are padded to a power-of-two schedule so a
-    growing live DAG triggers only O(log E) recompiles."""
+    growing live DAG triggers only O(log E) recompiles. With adaptive_r,
+    the expensive fame/received round axis is sized to the real round
+    count (learned across calls) instead of the loose topological-level
+    bound — often a 50x compute cut; an underestimate is detected via
+    last_round and re-run one bucket up."""
     import jax
 
+    global _r_fame_hint
+
     e_real = grid.e
+    offset = 0
     if bucketed:
+        grid, offset = rebase_rounds(grid)
         grid = pad_grid(grid)
-        # round the round axis as well: r_base (post-reset anchor rounds)
-        # would otherwise mint a fresh static shape per reset
         r_max = _bucket(grid.r_max, 64, factor=2)
     else:
         r_max = grid.r_max
-    # the fame offset loop is self-bounding (j <= last_round < r_max);
-    # d_cap is a static safety net only, so it never triggers recompiles
-    d_cap = d_max if d_max is not None else r_max + 2
 
-    res = kernels.consensus_pipeline(
-        grid.levels,
-        grid.creator,
-        grid.index,
-        grid.self_parent,
-        grid.other_parent,
-        grid.last_ancestors,
-        grid.first_descendants,
-        grid.ext_sp_round,
-        grid.ext_op_round,
-        grid.fixed_round,
-        grid.ext_sp_lamport,
-        grid.ext_op_lamport,
-        grid.fixed_lamport,
-        grid.coin_bit,
-        grid.super_majority,
-        grid.n,
-        r_max,
-        d_cap,
-    )
+    # the hint IS the previously chosen bucket — reusing it verbatim keeps
+    # the static shape (and therefore the compiled executable) stable
+    # across calls until the DAG genuinely outgrows it
+    # floor at the validator count: a round axis below the lane width
+    # tiles poorly (measured slower than N on TPU)
+    r_fame = min(max(_r_fame_hint, grid.n), r_max) if adaptive_r else r_max
+    while True:
+        # the fame offset loop is self-bounding (j <= last_round); d_cap is
+        # a static safety net only, so it never triggers recompiles
+        d_cap = d_max if d_max is not None else r_fame + 2
+        res = kernels.consensus_pipeline(
+            grid.levels,
+            grid.creator,
+            grid.index,
+            grid.self_parent,
+            grid.other_parent,
+            grid.last_ancestors,
+            grid.first_descendants,
+            grid.ext_sp_round,
+            grid.ext_op_round,
+            grid.fixed_round,
+            grid.ext_sp_lamport,
+            grid.ext_op_lamport,
+            grid.fixed_lamport,
+            grid.coin_bit,
+            grid.super_majority,
+            grid.n,
+            r_max,
+            r_fame,
+            d_cap,
+        )
+        last_round = int(res.last_round)
+        if last_round + 2 <= r_fame or r_fame >= r_max:
+            break
+        # overflow: fame/received beyond the table are garbage — grow and redo
+        r_fame = min(max(_bucket(last_round + 4, 8, factor=2), grid.n), r_max)
+    if adaptive_r:
+        _r_fame_hint = max(_r_fame_hint, r_fame)
+
     host = jax.device_get(res)  # one batched transfer
 
+    rounds = host.rounds[:e_real]
+    received = host.received[:e_real]
+    if offset:
+        rounds = np.where(rounds >= 0, rounds + offset, rounds)
+        received = np.where(received >= 0, received + offset, received)
+
     return PassResults(
-        rounds=host.rounds[:e_real],
+        rounds=rounds,
         witness=host.witness[:e_real],
         lamport=host.lamport[:e_real],
         witness_table=host.witness_table,
         fame_decided=host.fame_decided,
         famous=host.famous,
         rounds_decided=host.rounds_decided,
-        received=host.received[:e_real],
-        last_round=int(host.last_round),
+        received=received,
+        last_round=int(host.last_round) + offset,
+        round_offset=offset,
     )
 
 
@@ -168,7 +243,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
         hg.process_decided_rounds()
         hg.process_sig_pool()
         return
-    res = run_passes(grid, d_max=d_max, bucketed=True)
+    res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
     undetermined = set(hg.undetermined_events)
@@ -199,18 +274,22 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
             ri.add_event(h, bool(res.witness[r]))
 
     # --- write-back: DecideFame (reference: hashgraph.go:852-947) ---
+    # the (R, N) tables are indexed by round - round_offset (rebasing)
     decided_rounds = set()
     for pr in hg.pending_rounds:
         ri = round_infos.get(pr.index)
         if ri is None:
             ri = hg.store.get_round(pr.index)
             round_infos[pr.index] = ri
+        ti = pr.index - res.round_offset
+        if ti < 0 or ti >= res.witness_table.shape[0]:
+            continue
         for c in range(grid.n):
-            wrow = int(res.witness_table[pr.index, c])
+            wrow = int(res.witness_table[ti, c])
             if wrow < 0:
                 continue
-            if res.fame_decided[pr.index, c]:
-                ri.set_fame(grid.hashes[wrow], bool(res.famous[pr.index, c]))
+            if res.fame_decided[ti, c]:
+                ri.set_fame(grid.hashes[wrow], bool(res.famous[ti, c]))
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
     for pr in hg.pending_rounds:

@@ -305,7 +305,8 @@ def _decide_round_received(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("super_majority", "n_participants", "r_max", "d_cap")
+    jax.jit,
+    static_argnames=("super_majority", "n_participants", "r_max", "r_fame", "d_cap"),
 )
 def consensus_pipeline(
     levels: jax.Array,  # (L, N) int32 event rows, -1 padded
@@ -325,28 +326,39 @@ def consensus_pipeline(
     super_majority: int,
     n_participants: int,
     r_max: int,
+    r_fame: int,
     d_cap: int,
 ) -> PipelineResult:
-    """DivideRounds + DecideFame + DecideRoundReceived as one XLA program."""
+    """DivideRounds + DecideFame + DecideRoundReceived as one XLA program.
+
+    `r_max` bounds the witness-table scatter (cheap, so the loose
+    levels-based bound is fine); `r_fame` bounds the round axis of the
+    expensive fame/received tensors. The topological-level bound on rounds
+    is often 50x looser than the real last_round (long chains advance
+    rounds slowly), so callers pass a tight adaptive `r_fame` and check
+    `last_round + 2 <= r_fame` on the result — if it overflowed, fame and
+    received values are garbage and the caller re-runs with a bigger
+    bucket (engine.run_passes does this)."""
     dr = _divide_rounds(
         levels, creator, index, self_parent, other_parent, la, fd,
         ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport,
         ext_op_lamport, fixed_lamport, super_majority, r_max,
     )
     last_round = jnp.max(dr.rounds)
+    wtable = dr.witness_table[:r_fame]
     fame = _decide_fame(
-        dr.witness_table, la, fd, index, coin_bit, last_round,
+        wtable, la, fd, index, coin_bit, last_round,
         super_majority, n_participants, d_cap,
     )
     received = _decide_round_received(
-        dr.witness_table, la, index, creator, dr.rounds,
+        wtable, la, index, creator, dr.rounds,
         fame.decided, fame.famous, fame.rounds_decided, last_round,
     )
     return PipelineResult(
         rounds=dr.rounds,
         witness=dr.witness,
         lamport=dr.lamport,
-        witness_table=dr.witness_table,
+        witness_table=wtable,
         fame_decided=fame.decided,
         famous=fame.famous,
         rounds_decided=fame.rounds_decided,
