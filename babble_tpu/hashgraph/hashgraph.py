@@ -78,6 +78,11 @@ class Hashgraph:
         self.last_committed_round_events = 0
         self.sig_pool: List[BlockSignature] = []
         self.consensus_transactions = 0
+        # diagnostics: how often fame voting reached a coin round, and how
+        # often the coin (event-hash middle bit) actually decided a vote —
+        # lets tests prove the adversarial branch was exercised
+        self.coin_rounds = 0
+        self.coin_flips = 0
         self.pending_loaded_events = 0
         self.topological_index = 0
 
@@ -560,10 +565,12 @@ class Hashgraph:
                                 votes[(y, x)] = v
                             else:
                                 # coin round
+                                self.coin_rounds += 1
                                 if t >= self.super_majority:
                                     votes[(y, x)] = v
                                 else:
                                     votes[(y, x)] = middle_bit(y)
+                                    self.coin_flips += 1
 
             self.store.set_round(round_index, round_info)
             if round_info.witnesses_decided():

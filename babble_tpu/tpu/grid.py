@@ -29,7 +29,7 @@ hashgraph state, including after Reset/fast-sync.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -61,6 +61,9 @@ class DagGrid:
     levels: np.ndarray  # (L, N) int32 event rows, -1 padding
     num_levels: int
     hashes: Optional[List[str]] = None  # row -> event hex (host bookkeeping)
+    # per-event (row, col, value) first-descendant writes caused by that
+    # event's insert — the delta stream for the incremental engine
+    fd_update_stream: Optional[List[List[Tuple[int, int, int]]]] = None
 
     @property
     def r_base(self) -> int:
@@ -251,6 +254,7 @@ def synthetic_grid(
     e_count: int,
     seed: int = 0,
     zipf_a: float = 0.0,
+    record_fd_updates: bool = False,
 ) -> DagGrid:
     """Generate a random gossip DAG the way gossip produces one: each new
     event is a sync — creator c extends its own chain with an other-parent
@@ -264,6 +268,10 @@ def synthetic_grid(
     """
     rng = np.random.default_rng(seed)
     super_majority = 2 * n // 3 + 1
+    # per-event (row, col, value) first-descendant cell writes — the exact
+    # delta stream an incremental engine replays (own-cell write excluded;
+    # it rides with the appended row)
+    fd_updates: List[List[Tuple[int, int, int]]] = [[] for _ in range(e_count)]
 
     creator = np.zeros(e_count, dtype=np.int32)
     index = np.zeros(e_count, dtype=np.int32)
@@ -321,6 +329,8 @@ def synthetic_grid(
                 row = rows_by[p][a]
                 if fd[row, c] == MAX_INT32:
                     fd[row, c] = index[i]
+                    if record_fd_updates:
+                        fd_updates[i].append((row, c, int(index[i])))
                     a -= 1
                 else:
                     break
@@ -361,4 +371,5 @@ def synthetic_grid(
         fixed_lamport=fixed_lamport,
         levels=levels,
         num_levels=num_levels,
+        fd_update_stream=fd_updates if record_fd_updates else None,
     )

@@ -263,6 +263,26 @@ def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
     return min_la, famous_count, i_ok, horizon
 
 
+def received_core(index, rounds, seen_min, famous_count, i_ok, horizon_start):
+    """Shared candidate selection given precomputed per-event tables:
+    seen_min[e, i] = min over famous witnesses w of round i of
+    lastAnc[w][creator(e)], and horizon_start[e] = first undecided round
+    at-or-after rounds[e]+1. Callers differ only in how they build those
+    (gathers in the one-shot pipeline, one-hot matmuls in the incremental
+    engine where dynamic gathers are the bottleneck)."""
+    r_dim = seen_min.shape[1]
+    idx = jnp.arange(r_dim)
+    cand = (
+        (index[:, None] <= seen_min)
+        & (famous_count[None, :] > 0)
+        & i_ok[None, :]
+        & (idx[None, :] > rounds[:, None])
+        & (idx[None, :] < horizon_start[:, None])
+    )
+    received = jnp.min(jnp.where(cand, idx[None, :], r_dim), axis=1)
+    return jnp.where(received == r_dim, -1, received).astype(jnp.int32)
+
+
 def received_search(index, creator, rounds, min_la, famous_count, i_ok, horizon):
     """The per-event round-received candidate search, shared verbatim by the
     single-device pipeline and the events-sharded map (sharded.py):
@@ -272,23 +292,11 @@ def received_search(index, creator, rounds, min_la, famous_count, i_ok, horizon)
     witnesses of i see e } (reference: hashgraph.go:951-1036).
     """
     r_dim = min_la.shape[0]
-    idx = jnp.arange(r_dim)
-
-    # candidate matrix (E, R): event e received at round i?
-    seen_all = index[:, None] <= min_la[:, creator].T  # (E, R)
-    cand = (
-        seen_all
-        & (famous_count[None, :] > 0)
-        & i_ok[None, :]
-        & (idx[None, :] > rounds[:, None])
-    )
-    # prefix condition: every round in (rounds[e], i] decided ->
-    # i < horizon[rounds[e]+1]
+    seen_min = min_la[:, creator].T  # (E, R)
     start = jnp.clip(rounds + 1, 0, r_dim - 1)
-    cand = cand & (idx[None, :] < horizon[start][:, None])
-
-    received = jnp.min(jnp.where(cand, idx[None, :], r_dim), axis=1)
-    return jnp.where(received == r_dim, -1, received).astype(jnp.int32)
+    return received_core(
+        index, rounds, seen_min, famous_count, i_ok, horizon[start]
+    )
 
 
 def _decide_round_received(

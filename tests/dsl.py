@@ -205,3 +205,102 @@ def get_name(index: Dict[str, str], hash_: str) -> str:
         if h == hash_:
             return name
     return f"unknown event {hash_}"
+
+
+def _init_with_tx_firsts(n: int):
+    """Fixture style where the first events carry their own name as tx
+    payload (reference funky/sparse builders, hashgraph_test.go:2030,2482)."""
+    nodes, index, ordered, participants = init_hashgraph_nodes(n)
+    for i, peer in enumerate(participants.to_peer_slice()):
+        name = f"w0{i}"
+        ev = Event(
+            transactions=[name.encode()],
+            block_signatures=None,
+            parents=[root_self_parent(peer.id), ""],
+            creator=nodes[i].pub,
+            index=0,
+        )
+        nodes[i].sign_and_add_event(ev, name, index, ordered)
+    return nodes, index, ordered, participants
+
+
+def _named_plays(raw):
+    """(to, index, self_parent, other_parent, name) tuples where the name is
+    also the tx payload — the funky/sparse play style."""
+    return [Play(t, i, sp, op, nm, [nm.encode()]) for t, i, sp, op, nm in raw]
+
+
+def init_funky_hashgraph(full: bool = False, store_factory=None):
+    """Adversarial 4-node topology where later rounds decide fame BEFORE
+    earlier ones and the coin-round branch of DecideFame is reached
+    (reference: hashgraph_test.go:2030-2080)."""
+    nodes, index, ordered, participants = _init_with_tx_firsts(4)
+    plays = _named_plays([
+        (2, 1, "w02", "w03", "a23"),
+        (1, 1, "w01", "a23", "a12"),
+        (0, 1, "w00", "", "a00"),
+        (1, 2, "a12", "a00", "a10"),
+        (2, 2, "a23", "a12", "a21"),
+        (3, 1, "w03", "a21", "w13"),
+        (2, 3, "a21", "w13", "w12"),
+        (1, 3, "a10", "w12", "w11"),
+        (0, 2, "a00", "w11", "w10"),
+        (2, 4, "w12", "w11", "b21"),
+        (3, 2, "w13", "b21", "w23"),
+        (1, 4, "w11", "w23", "w21"),
+        (0, 3, "w10", "", "b00"),
+        (1, 5, "w21", "b00", "c10"),
+        (2, 5, "b21", "c10", "w22"),
+        (0, 4, "b00", "w22", "w20"),
+        (1, 6, "c10", "w20", "w31"),
+        (2, 6, "w22", "w31", "w32"),
+        (0, 5, "w20", "w32", "w30"),
+        (3, 3, "w23", "w32", "w33"),
+        (1, 7, "w31", "w33", "d13"),
+        (0, 6, "w30", "d13", "w40"),
+        (1, 8, "d13", "w40", "w41"),
+        (2, 7, "w32", "w41", "w42"),
+        (3, 4, "w33", "w42", "w43"),
+    ])
+    if full:
+        plays += _named_plays([
+            (2, 8, "w42", "w43", "e23"),
+            (1, 9, "w41", "e23", "w51"),
+        ])
+    play_events(plays, nodes, index, ordered)
+    store = store_factory(participants) if store_factory else None
+    h = create_hashgraph(ordered, participants, store)
+    return h, index, ordered
+
+
+def init_sparse_hashgraph(store_factory=None):
+    """4-node topology with rounds whose witness sets are sparse — some
+    participants skip rounds entirely (reference: hashgraph_test.go:2482)."""
+    nodes, index, ordered, participants = _init_with_tx_firsts(4)
+    plays = _named_plays([
+        (1, 1, "w01", "w00", "e10"),
+        (2, 1, "w02", "e10", "e21"),
+        (3, 1, "w03", "e21", "e32"),
+        (0, 1, "w00", "e32", "w10"),
+        (1, 2, "e10", "w10", "w11"),
+        (0, 2, "w10", "w11", "f01"),
+        (2, 2, "e21", "f01", "w12"),
+        (3, 2, "e32", "w12", "w13"),
+        (1, 3, "w11", "w13", "w21"),
+        (2, 3, "w12", "w21", "w22"),
+        (3, 3, "w13", "w22", "w23"),
+        (1, 4, "w21", "w23", "g13"),
+        (2, 4, "w22", "g13", "w32"),
+        (3, 4, "w23", "w32", "w33"),
+        (1, 5, "g13", "w33", "w31"),
+        (2, 5, "w32", "w31", "h21"),
+        (3, 5, "w33", "h21", "w43"),
+        (1, 6, "w31", "w43", "w41"),
+        (2, 6, "h21", "w41", "w42"),
+        (3, 6, "w43", "w42", "i32"),
+        (1, 7, "w41", "i32", "w51"),
+    ])
+    play_events(plays, nodes, index, ordered)
+    store = store_factory(participants) if store_factory else None
+    h = create_hashgraph(ordered, participants, store)
+    return h, index, ordered

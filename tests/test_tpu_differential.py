@@ -18,8 +18,10 @@ from babble_tpu.tpu.grid import MAX_INT32
 
 from dsl import (
     init_consensus_hashgraph,
+    init_funky_hashgraph,
     init_round_hashgraph,
     init_simple_hashgraph,
+    init_sparse_hashgraph,
 )
 
 
@@ -99,6 +101,23 @@ def test_round_hashgraph_differential():
 
 def test_consensus_hashgraph_differential():
     hg, _, _ = init_consensus_hashgraph()
+    assert_equivalent(hg)
+
+
+def test_funky_hashgraph_differential():
+    """The adversarial coin-round topology: the CPU engine demonstrably
+    takes the coin branch, and the device engine must agree bit-exactly on
+    every fame verdict anyway (the kernel's coin path uses the same
+    precomputed event-hash middle bits)."""
+    hg, _, _ = init_funky_hashgraph(full=True)
+    cpu, dev, cpu_blocks, dev_blocks = run_both(hg)
+    assert cpu.coin_rounds > 0, "fixture no longer exercises the coin branch"
+    assert_equivalent(hg)
+
+
+def test_sparse_hashgraph_differential():
+    """Rounds with sparse witness sets (participants skipping rounds)."""
+    hg, _, _ = init_sparse_hashgraph()
     assert_equivalent(hg)
 
 
