@@ -73,7 +73,8 @@ def main():
         state.last_round + jnp.sum(state.rounds) + jnp.sum(state.received)
     ))
     elapsed = time.perf_counter() - start
-    assert not bool(state.stale), "received window undersized (stale latch)" 
+    assert not bool(state.stale), "received window undersized (stale latch)"
+    assert not bool(state.fame_lag), "fame unroll exceeded (fame_lag latch)"
     events_per_sec = grid.e / elapsed
 
     # differential gate vs the one-shot pipeline

@@ -5,15 +5,12 @@ coin rounds), sparse (participants skipping rounds), and forks
 
 import pytest
 
-from babble_tpu import crypto
-from babble_tpu.hashgraph import Event
+from babble_tpu.hashgraph import Event, root_self_parent
 
 from dsl import (
-    Play,
     init_funky_hashgraph,
     init_hashgraph_nodes,
     init_sparse_hashgraph,
-    play_events,
     create_hashgraph,
 )
 
@@ -85,8 +82,6 @@ def test_fork_rejected():
     hashgraph_test.go:351-398)."""
     nodes, index, ordered, participants = init_hashgraph_nodes(3)
     for i, peer in enumerate(participants.to_peer_slice()):
-        from babble_tpu.hashgraph import root_self_parent
-
         ev = Event(parents=[root_self_parent(peer.id), ""],
                    creator=nodes[i].pub, index=0)
         nodes[i].sign_and_add_event(ev, f"e{i}", index, ordered)
