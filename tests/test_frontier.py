@@ -1,0 +1,61 @@
+"""Differential oracle for the round-frontier DivideRounds: it must match
+the level-scan kernel bit-exactly on every DAG — rounds, witness flags,
+witness tables, fame and round-received."""
+
+import numpy as np
+import pytest
+
+from babble_tpu.tpu import synthetic_grid
+from babble_tpu.tpu.engine import run_passes
+from babble_tpu.tpu.frontier import (
+    build_inv,
+    chain_table,
+    frontier_pipeline,
+    level_lamport,
+    sp_index_of,
+)
+
+
+def run_frontier(grid, r_cap):
+    ref = run_passes(grid)  # level-scan reference
+    rows_by = chain_table(grid)
+    inv = build_inv(rows_by, grid.last_ancestors)
+    res = frontier_pipeline(
+        inv, rows_by, grid.creator, grid.index, sp_index_of(grid),
+        grid.last_ancestors, grid.first_descendants,
+        level_lamport(grid), grid.coin_bit,
+        grid.super_majority, grid.n, r_cap,
+    )
+    return ref, res
+
+
+@pytest.mark.parametrize("n,e,seed,zipf", [
+    (4, 64, 1, 0.0),
+    (8, 256, 2, 0.0),
+    (8, 512, 3, 1.1),
+    (16, 1024, 4, 1.1),
+    (8, 300, 7, 2.0),  # heavy skew: deep chains, frequent round jumps
+])
+def test_frontier_matches_scan(n, e, seed, zipf):
+    grid = synthetic_grid(n, e, seed=seed, zipf_a=zipf)
+    r_cap = 64
+    ref, res = run_frontier(grid, r_cap)
+
+    np.testing.assert_array_equal(np.asarray(res.rounds), ref.rounds)
+    np.testing.assert_array_equal(np.asarray(res.witness), ref.witness)
+    np.testing.assert_array_equal(np.asarray(res.lamport), ref.lamport)
+    assert int(res.last_round) == ref.last_round
+    # witness tables agree on every real round
+    r = ref.last_round + 1
+    np.testing.assert_array_equal(
+        np.asarray(res.witness_table)[:r], ref.witness_table[:r]
+    )
+    # downstream passes agree
+    np.testing.assert_array_equal(
+        np.asarray(res.fame_decided)[:r], ref.fame_decided[:r]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.famous)[:r] & np.asarray(res.fame_decided)[:r],
+        ref.famous[:r] & ref.fame_decided[:r],
+    )
+    np.testing.assert_array_equal(np.asarray(res.received), ref.received)
