@@ -77,7 +77,7 @@ def test_device_backend_cluster():
     nodes, proxies, *_ = build_mixed_cluster(["tpu"] * 4)
     try:
         run_nodes(nodes)
-        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
         check_gossip(nodes, upto=2)
         for node in nodes:
             assert node.core.device_consensus_runs > 0, (
@@ -97,7 +97,7 @@ def test_mixed_backend_cluster_byte_identical():
     nodes, proxies, *_ = build_mixed_cluster(["cpu", "tpu", "cpu", "tpu"])
     try:
         run_nodes(nodes)
-        bombard_and_wait(nodes, proxies, target_block=3, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=3, timeout_s=180)
         check_gossip(nodes, upto=3)
         for i in range(3 + 1):
             hashes = {n.get_block(i).state_hash() for n in nodes}
@@ -119,7 +119,7 @@ def test_device_backend_survives_fast_sync():
     conf = make_config()
     try:
         run_nodes(nodes)
-        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
 
         victim = nodes[3]
         victim.shutdown()
@@ -131,7 +131,7 @@ def test_device_backend_survives_fast_sync():
         goal_ahead = max(n.core.get_last_block_index() for n in nodes[:3]) + 3
         while True:
             bombard_and_wait(
-                nodes[:3], proxies[:3], target_block=goal_ahead, timeout_s=90
+                nodes[:3], proxies[:3], target_block=goal_ahead, timeout_s=180
             )
             total_events = sum(
                 i + 1 for i in nodes[0].core.known_events().values()
@@ -156,7 +156,7 @@ def test_device_backend_survives_fast_sync():
         # generous: under full-suite load the joiner may need several
         # fast-forward attempts while the survivors keep racing ahead
         goal = goal_ahead + 5
-        bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=150)
+        bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=240)
         start = first_available_block(node, goal)
         check_gossip(nodes, from_block=start, upto=goal)
 

@@ -126,7 +126,7 @@ def test_catch_up():
         # run until the joiner would be beyond the sync limit
         target = 3
         while True:
-            bombard_and_wait(nodes3, proxies3, target_block=target, timeout_s=90)
+            bombard_and_wait(nodes3, proxies3, target_block=target, timeout_s=180)
             total_events = sum(
                 i + 1 for i in nodes3[0].core.known_events().values()
             )
@@ -136,7 +136,7 @@ def test_catch_up():
         target = min(n.core.get_last_block_index() for n in nodes3)
 
         node4.run_async(True)
-        bombard_and_wait(nodes, proxies, target_block=target + 2, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=target + 2, timeout_s=180)
         # node4 joined mid-history: its first block came from a frame,
         # and from there on bodies must be byte-identical
         start = first_available_block(node4, target + 2)
@@ -152,7 +152,7 @@ def test_fast_sync_repeated():
     nodes, proxies, keys, peer_list, participants, transports = build_cluster(4, conf)
     try:
         run_nodes(nodes)
-        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
 
         for _round in range(2):
             victim = nodes[3]
@@ -167,7 +167,7 @@ def test_fast_sync_repeated():
             goal_ahead = base + 3
             while True:
                 bombard_and_wait(
-                    nodes[:3], proxies[:3], target_block=goal_ahead, timeout_s=90
+                    nodes[:3], proxies[:3], target_block=goal_ahead, timeout_s=180
                 )
                 total_events = sum(
                     i + 1 for i in nodes[0].core.known_events().values()
@@ -194,7 +194,7 @@ def test_fast_sync_repeated():
             # generous: under full-suite load the joiner may need several
             # fast-forward attempts while the survivors keep racing ahead
             goal = base + 5
-            bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=150)
+            bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=240)
             start = first_available_block(node, goal)
             check_gossip(nodes, from_block=start, upto=goal)
     finally:
@@ -216,7 +216,7 @@ def test_bootstrap_all_nodes(tmp_path):
     )
     try:
         run_nodes(nodes)
-        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=60)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
         check_gossip(nodes, upto=2)
         base = min(n.core.get_last_block_index() for n in nodes)
         shutdown_nodes(nodes)
@@ -247,7 +247,7 @@ def test_bootstrap_all_nodes(tmp_path):
             proxies2.append(prox)
 
         run_nodes(nodes2)
-        bombard_and_wait(nodes2, proxies2, target_block=base + 2, timeout_s=60)
+        bombard_and_wait(nodes2, proxies2, target_block=base + 2, timeout_s=180)
         check_gossip(nodes2, upto=base + 2)
         nodes = nodes2  # for the finally clause
     finally:
