@@ -35,6 +35,7 @@ def run_frontier(grid, r_cap):
     (8, 512, 3, 1.1),
     (16, 1024, 4, 1.1),
     (8, 300, 7, 2.0),  # heavy skew: deep chains, frequent round jumps
+    (32, 768, 9, 1.1),  # wider validator set (supermajority = 22)
 ])
 def test_frontier_matches_scan(n, e, seed, zipf):
     grid = synthetic_grid(n, e, seed=seed, zipf_a=zipf)
