@@ -432,6 +432,11 @@ class Node(NodeStateMachine):
             "round_events": str(self.core.get_last_committed_round_events_count()),
             "id": str(self.id),
             "state": str(self.get_state()),
+            # beyond reference parity: which consensus engine served this
+            # node and how often the device path ran / fell back
+            "consensus_backend": self.core.consensus_backend,
+            "device_consensus_runs": str(self.core.device_consensus_runs),
+            "device_consensus_fallbacks": str(self.core.device_consensus_fallbacks),
         }
 
     def log_stats(self) -> None:
