@@ -155,8 +155,6 @@ def run_passes(
     last_round and re-run one bucket up."""
     import jax
 
-    global _r_fame_hint
-
     e_real = grid.e
     offset = 0
     if bucketed:
@@ -166,17 +164,11 @@ def run_passes(
     else:
         r_max = grid.r_max
 
-    # the hint IS the previously chosen bucket — reusing it verbatim keeps
-    # the static shape (and therefore the compiled executable) stable
-    # across calls until the DAG genuinely outgrows it
-    # floor at the validator count: a round axis below the lane width
-    # tiles poorly (measured slower than N on TPU)
-    r_fame = min(max(_r_fame_hint, grid.n), r_max) if adaptive_r else r_max
-    while True:
+    def run_fn(r_fame):
         # the fame offset loop is self-bounding (j <= last_round); d_cap is
         # a static safety net only, so it never triggers recompiles
         d_cap = d_max if d_max is not None else r_fame + 2
-        res = kernels.consensus_pipeline(
+        return kernels.consensus_pipeline(
             grid.levels,
             grid.creator,
             grid.index,
@@ -197,13 +189,11 @@ def run_passes(
             r_fame,
             d_cap,
         )
-        last_round = int(res.last_round)
-        if last_round + 2 <= r_fame or r_fame >= r_max:
-            break
-        # overflow: fame/received beyond the table are garbage — grow and redo
-        r_fame = min(max(_bucket(last_round + 4, 8, factor=2), grid.n), r_max)
+
     if adaptive_r:
-        _r_fame_hint = max(_r_fame_hint, r_fame)
+        res, _ = _adaptive_r_loop(run_fn, grid.n, r_max)
+    else:
+        res = run_fn(r_max)
 
     host = jax.device_get(res)  # one batched transfer
 
@@ -227,13 +217,111 @@ def run_passes(
     )
 
 
+def _frontier_safe(grid: DagGrid) -> bool:
+    """The round-frontier kernel covers base-state grids: every chain
+    anchored at a genesis root (no external parent metadata from resets).
+    Pinned rounds/lamports are fine — recompute equals them on such grids."""
+    return (
+        grid.e > 0
+        and bool((grid.ext_sp_round == -1).all())
+        and bool((grid.ext_op_round == -1).all())
+    )
+
+
+def _adaptive_r_loop(run_fn, n: int, cap_bound: int):
+    """Shared adaptive round-axis protocol: start from the grow-only hint
+    (floored at the validator count — a round axis under the lane width
+    tiles poorly), re-run one bucket up on overflow, and remember the
+    final bucket so the next call reuses the compiled executable."""
+    global _r_fame_hint
+
+    r_cap = min(max(_r_fame_hint, n), cap_bound)
+    while True:
+        res = run_fn(r_cap)
+        last_round = int(res.last_round)
+        if last_round + 2 <= r_cap or r_cap >= cap_bound:
+            break
+        r_cap = min(max(_bucket(last_round + 4, 8, factor=2), n), cap_bound)
+    _r_fame_hint = max(_r_fame_hint, r_cap)
+    return res, last_round
+
+
+def run_frontier_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResults:
+    """The live-engine adapter for the round-frontier pipeline
+    (babble_tpu/tpu/frontier.py): bucketed shapes, adaptive round axis,
+    same PassResults contract as run_passes. Caller must have checked
+    _frontier_safe."""
+    import jax
+
+    from .frontier import (
+        build_inv, chain_table, frontier_pipeline, level_lamport, sp_index_of,
+    )
+
+    global _r_fame_hint
+
+    e_real = grid.e
+    rows_by = chain_table(grid)
+    sp_index = sp_index_of(grid)
+    lamport = level_lamport(grid)
+    grid_p = pad_grid(grid)
+    pad_e = grid_p.creator.shape[0] - e_real
+    # E-padding for the frontier path: index -1 keeps padded rows below
+    # every frontier value, so their rounds stay -1 and cannot pollute
+    # last_round (pad_grid's MAX fill serves the scan path's received
+    # semantics and would do the opposite here)
+    index = np.concatenate(
+        [grid.index, np.full(pad_e, -1, dtype=np.int32)]
+    )
+    sp_index = np.concatenate(
+        [sp_index, np.full(pad_e, -1, dtype=np.int32)]
+    )
+    lamport = np.concatenate(
+        [lamport, np.full(pad_e, -1, dtype=np.int32)]
+    )
+    # bucket the chain axis so chain growth recompiles O(log L) times
+    # (rows_by values index real rows only, so it needs no E padding)
+    l_b = _bucket(rows_by.shape[1], 64, factor=2)
+    if l_b != rows_by.shape[1]:
+        ext = np.full((grid.n, l_b), -1, dtype=np.int32)
+        ext[:, : rows_by.shape[1]] = rows_by
+        rows_by = ext
+
+    inv = build_inv(rows_by, grid_p.last_ancestors)
+
+    def run_fn(r_cap):
+        return frontier_pipeline(
+            inv, rows_by, grid_p.creator, index, sp_index,
+            grid_p.last_ancestors, grid_p.first_descendants,
+            lamport, grid_p.coin_bit,
+            grid.super_majority, grid.n, r_cap, d_cap=d_max,
+        )
+
+    res, last_round = _adaptive_r_loop(run_fn, grid.n, l_b + 2)
+
+    host = jax.device_get(res)
+    return PassResults(
+        rounds=host.rounds[:e_real],
+        witness=host.witness[:e_real],
+        lamport=host.lamport[:e_real],
+        witness_table=host.witness_table,
+        fame_decided=host.fame_decided,
+        famous=host.famous,
+        rounds_decided=host.rounds_decided,
+        received=host.received[:e_real],
+        last_round=last_round,
+        round_offset=0,
+    )
+
+
 def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
     """Full five-pass pipeline with passes 1-3 on device.
 
     Equivalent to Hashgraph.run_consensus() on a freshly-inserted DAG:
     extract grid -> device passes -> write rounds/witness/lamport/fame/
     received back into the store -> host ProcessDecidedRounds +
-    ProcessSigPool (unchanged, so blocks come out byte-identical).
+    ProcessSigPool (unchanged, so blocks come out byte-identical). Base
+    grids ride the round-frontier kernel; post-reset states use the
+    level scan.
     """
     from ..common import StoreErr, StoreErrType, is_store_err
     from ..hashgraph import RoundInfo, PendingRound
@@ -243,7 +331,10 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
         hg.process_decided_rounds()
         hg.process_sig_pool()
         return
-    res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
+    if _frontier_safe(grid):
+        res = run_frontier_passes(grid, d_max=d_max)
+    else:
+        res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
     undetermined = set(hg.undetermined_events)

@@ -215,7 +215,7 @@ frontier_rounds = functools.partial(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_cap"),
+    static_argnames=("super_majority", "n_participants", "r_cap", "d_cap"),
 )
 def frontier_pipeline(
     inv_f32: jax.Array,  # (N, N, L) f32 from build_inv
@@ -230,15 +230,19 @@ def frontier_pipeline(
     super_majority: int,
     n_participants: int,
     r_cap: int,
+    d_cap: int = None,
 ) -> PipelineResult:
     """DivideRounds (frontier walk) + DecideFame + DecideRoundReceived as
-    one XLA program; same output contract as kernels.consensus_pipeline."""
+    one XLA program; same output contract as kernels.consensus_pipeline.
+    d_cap optionally caps the fame voting offset (the static safety net of
+    the scan pipeline); default = r_cap + 2."""
     fr = _frontier_rounds(
         inv_f32, rows_by, creator, index, sp_index, fd, super_majority, r_cap
     )
     fame = _decide_fame(
         fr.witness_table, la, fd, index, coin_bit, fr.last_round,
-        super_majority, n_participants, r_cap + 2,
+        super_majority, n_participants,
+        r_cap + 2 if d_cap is None else d_cap,
     )
     received = _decide_round_received(
         fr.witness_table, la, index, creator, fr.rounds,
