@@ -57,7 +57,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .grid import DagGrid, MAX_INT32
-from .kernels import PipelineResult, _decide_fame, _decide_round_received
+from .kernels import (
+    PipelineResult,
+    _decide_fame,
+    _decide_round_received,
+    suffix_min,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -122,9 +127,7 @@ def build_inv(rows_by: jax.Array, la: jax.Array) -> jax.Array:
     v_slot = jnp.where(la_chain >= 0, jnp.minimum(la_chain, l - 1), l)
     inv0 = jnp.full((n, n, l + 1), l, jnp.int32)
     inv0 = inv0.at[c_idx, p_idx, v_slot].min(i_idx)
-    inv = jax.lax.associative_scan(
-        jnp.minimum, inv0[:, :, :l], reverse=True, axis=2
-    )
+    inv = suffix_min(inv0[:, :, :l], l, axis=2)
     return inv.astype(jnp.float32)
 
 

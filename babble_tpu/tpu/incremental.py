@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .kernels import MAX_INT32, received_core
+from .kernels import MAX_INT32, received_core, suffix_min
 from .grid import DagGrid
 
 
@@ -342,7 +342,7 @@ def _decide_body(
     )  # (R, N_c)
     i_ok = rounds_decided & (r_idx <= last_round)
     bad = jnp.where(~i_ok, r_idx, r_cap)
-    horizon = jax.lax.associative_scan(jnp.minimum, bad, reverse=True)
+    horizon = suffix_min(bad, r_cap)
 
     lo = jnp.clip(state.count - e_win, 0, e_cap - e_win)
     idx_e = jax.lax.dynamic_slice(index, (lo,), (e_win,))

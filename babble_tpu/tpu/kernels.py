@@ -49,6 +49,27 @@ MIN_INT32 = -(2**31)
 NEG = jnp.int32(-1)
 
 
+def suffix_min(x: jax.Array, fill, axis: int = -1) -> jax.Array:
+    """Reverse cumulative minimum along `axis` via explicit log-step shift
+    doubling. Used instead of jax.lax.associative_scan(min, reverse=True),
+    which was observed to silently produce corrupt results on the TPU
+    platform at large shapes (~2800-length axes)."""
+    axis = axis % x.ndim
+    length = x.shape[axis]
+    k = 1
+    while k < length:
+        lead = [slice(None)] * x.ndim
+        lead[axis] = slice(k, None)
+        pad_shape = list(x.shape)
+        pad_shape[axis] = k
+        shifted = jnp.concatenate(
+            [x[tuple(lead)], jnp.full(pad_shape, fill, x.dtype)], axis=axis
+        )
+        x = jnp.minimum(x, shifted)
+        k *= 2
+    return x
+
+
 class DivideRoundsResult(NamedTuple):
     rounds: jax.Array  # (E,) int32
     witness: jax.Array  # (E,) bool
@@ -259,7 +280,7 @@ def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
     # first non-decided round at-or-after k, as a suffix-scan:
     # horizon[k] = min{ i >= k : not i_ok[i] }  (r_max if none)
     bad = jnp.where(~i_ok, idx, r_max)
-    horizon = jax.lax.associative_scan(jnp.minimum, bad, reverse=True)  # (R,)
+    horizon = suffix_min(bad, r_max)  # (R,)
     return min_la, famous_count, i_ok, horizon
 
 

@@ -1,0 +1,63 @@
+"""Host gossip benchmark: 4 full nodes over the in-memory transport run to
+50 committed blocks with byte-equality verified — the reference's
+BenchmarkGossip configuration (reference: src/node/node_test.go:800-807)
+whose CI-enforced floor is 50 blocks in < 3 s (node_test.go:422-437).
+
+Prints one JSON line like bench.py. Runs on CPU (host runtime only).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TARGET_BLOCKS = 50
+REFERENCE_FLOOR_S = 3.0
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from test_node import (
+        bombard_and_wait,
+        check_gossip,
+        init_nodes,
+        run_nodes,
+        shutdown_nodes,
+    )
+
+    t0 = time.perf_counter()
+    nodes, proxies = init_nodes(4)
+    run_nodes(nodes)
+    try:
+        bombard_and_wait(nodes, proxies, target_block=TARGET_BLOCKS, timeout_s=120)
+        elapsed = time.perf_counter() - t0
+        check_gossip(nodes, upto=TARGET_BLOCKS)
+    finally:
+        shutdown_nodes(nodes)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"wall seconds for 4 nodes to commit {TARGET_BLOCKS} "
+                    "byte-identical blocks (inmem transport)"
+                ),
+                "value": round(elapsed, 2),
+                "unit": "s",
+                # <1 means faster than the reference's CI floor
+                "vs_baseline": round(elapsed / REFERENCE_FLOOR_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -60,3 +60,16 @@ def test_frontier_matches_scan(n, e, seed, zipf):
         ref.famous[:r] & ref.fame_decided[:r],
     )
     np.testing.assert_array_equal(np.asarray(res.received), ref.received)
+
+
+def test_suffix_min_matches_numpy():
+    """suffix_min replaces lax.associative_scan(min, reverse=True), which
+    silently corrupts on some platforms at large shapes — pin the exact
+    semantics at the shapes the INV build uses."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 3000, size=(4, 5, 2801)).astype(np.int32)
+    from babble_tpu.tpu.kernels import suffix_min
+
+    got = np.asarray(suffix_min(x, 3000, axis=2))
+    want = np.minimum.accumulate(x[:, :, ::-1], axis=2)[:, :, ::-1]
+    np.testing.assert_array_equal(got, want)
