@@ -197,7 +197,10 @@ class Node(NodeStateMachine):
         state = self.get_state()
         if state != NodeState.BABBLING:
             self.logger.debug("Discarding RPC Request in state %s", state)
-            rpc.respond(SyncResponse(from_id=self.id), error=f"not ready: {state}")
+            # error-only response: both transports short-circuit on the
+            # error before deserializing a body, so no command ever gets a
+            # mismatched response type
+            rpc.respond(None, error=f"not ready: {state}")
             return
         cmd = rpc.command
         if isinstance(cmd, SyncRequest):

@@ -53,7 +53,12 @@ def suffix_min(x: jax.Array, fill, axis: int = -1) -> jax.Array:
     """Reverse cumulative minimum along `axis` via explicit log-step shift
     doubling. Used instead of jax.lax.associative_scan(min, reverse=True),
     which was observed to silently produce corrupt results on the TPU
-    platform at large shapes (~2800-length axes)."""
+    platform at large shapes (~2800-length axes).
+
+    `fill` pads the shifted tail and MUST be >= every element of x (a min
+    identity for the data range) — a smaller fill would propagate inward
+    and corrupt the suffix minima. Callers pass the axis-domain sentinel
+    (r_max / r_cap / chain length), which bounds all stored values."""
     axis = axis % x.ndim
     length = x.shape[axis]
     k = 1
