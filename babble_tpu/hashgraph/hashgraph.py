@@ -104,6 +104,9 @@ class Hashgraph:
         # it are undecidable here and skipped in the round-received scan
         self.frozen_refs: Dict[str, FrozenRef] = {}
         self.reset_floor: Optional[int] = None
+        # optional hook: called as (event, fd_writes) after every insert —
+        # the incremental device engine's delta feed (babble_tpu/tpu/live.py)
+        self.insert_listener = None
 
     # ------------------------------------------------------------------
     # positions
@@ -357,11 +360,14 @@ class Hashgraph:
         event.first_descendants[pos] = coords
         event.last_ancestors[pos] = coords
 
-    def _update_ancestor_first_descendant(self, event: Event) -> None:
+    def _update_ancestor_first_descendant(self, event: Event) -> List[tuple]:
         """Walk each last-ancestor's self-parent chain marking this event as
-        first descendant (reference: src/hashgraph/hashgraph.go:510-544)."""
+        first descendant (reference: src/hashgraph/hashgraph.go:510-544).
+        Returns the (ancestor_hash, creator_pos, index) cells written — the
+        delta stream an incremental device engine replays."""
         pos = self._pos_by_pubkey[event.creator()]
         coords = (event.index(), event.hex())
+        writes: List[tuple] = []
         for _, ah in event.last_ancestors:
             while ah != "":
                 try:
@@ -371,9 +377,11 @@ class Hashgraph:
                 if a.first_descendants[pos][0] == MAX_INT32:
                     a.first_descendants[pos] = coords
                     self.store.set_event(a)
+                    writes.append((ah, pos, coords[0]))
                     ah = a.self_parent()
                 else:
                     break
+        return writes
 
     def insert_event(self, event: Event, set_wire_info: bool) -> None:
         if not event.verify():
@@ -390,7 +398,9 @@ class Hashgraph:
 
         self._init_event_coordinates(event)
         self.store.set_event(event)
-        self._update_ancestor_first_descendant(event)
+        fd_writes = self._update_ancestor_first_descendant(event)
+        if self.insert_listener is not None:
+            self.insert_listener(event, fd_writes)
 
         self.undetermined_events.append(event.hex())
         if event.is_loaded():
@@ -777,6 +787,11 @@ class Hashgraph:
         return block, frame
 
     def reset(self, block: Block, frame: Frame) -> None:
+        # any incremental device state is invalid after a reset
+        eng = getattr(self, "_live_device_engine", None)
+        if eng is not None:
+            eng.detach()
+            self._live_device_engine = None
         self.last_consensus_round = None
         self.first_consensus_round = None
         self.anchor_block = None

@@ -56,6 +56,9 @@ class Core:
         # (e.g. a rolled store window); cleared on fast-forward, which
         # compacts the state back into grid range
         self._device_down = False
+        # sticky: the incremental live engine hit an unsupported state
+        # (post-reset, capacity) — use the one-shot device path instead
+        self._live_down = False
 
     # -- identity ----------------------------------------------------------
 
@@ -184,6 +187,7 @@ class Core:
             self.hg.apply_section(section)
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
+        self._live_down = True  # post-reset states stay one-shot
         self.run_consensus()
 
     def fast_forward(
@@ -226,6 +230,32 @@ class Core:
             from ..tpu.engine import run_consensus_device
             from ..tpu.grid import GridUnsupported
 
+            if not self._live_down:
+                from ..tpu.live import run_consensus_live
+
+                try:
+                    run_consensus_live(self.hg)
+                    self.device_consensus_runs += 1
+                    return
+                except Exception as e:  # noqa: BLE001 — any failure leaves
+                    # the engine's device state desynced from its host
+                    # bookkeeping: drop it entirely (the one-shot path
+                    # recomputes from the store, so nothing is lost) and
+                    # stop retrying
+                    self._live_down = True
+                    eng = getattr(self.hg, "_live_device_engine", None)
+                    if eng is not None:
+                        eng.detach()
+                        self.hg._live_device_engine = None
+                    log = (
+                        self.logger.info
+                        if isinstance(e, GridUnsupported)
+                        else self.logger.warning
+                    )
+                    log(
+                        "incremental device engine unavailable (%s); "
+                        "one-shot device path", e
+                    )
             try:
                 run_consensus_device(self.hg)
                 self.device_consensus_runs += 1

@@ -1,0 +1,411 @@
+"""Live-node incremental device consensus: the persistent append-batch
+pipeline (babble_tpu/tpu/incremental.py) wired into a running Hashgraph.
+
+Where run_consensus_device re-stages the full DAG every sync (O(E) host
+work per call), this engine keeps the DAG on device and ships only the
+events inserted since the last consensus call — the host work per sync is
+O(batch), mirroring the reference's UndeterminedEvents discipline
+(reference: src/hashgraph/hashgraph.go:36-40,767-780) with device-resident
+state.
+
+Wiring: the Hashgraph's insert path reports each inserted event plus the
+first-descendant cells its insert wrote (hashgraph.insert_listener);
+run_consensus_live drains that queue into fixed-shape append batches,
+advances the device state, and writes new rounds/fame/received back into
+the store exactly like the one-shot engine. Passes 4-5 stay host-side, so
+blocks remain byte-identical by construction.
+
+Scope and fallback: base-state hashgraphs only (no resets — the dense
+incremental state has no external-parent metadata). Any unsupported
+condition (post-reset state, capacity overflow, fame-unroll exhaustion,
+received-window staleness) raises GridUnsupported, and Core falls back to
+the one-shot device path (which itself falls back to the CPU engine).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .grid import MAX_INT32, DagGrid, GridUnsupported, grid_from_hashgraph
+from .incremental import (
+    Batch,
+    IncState,
+    L_MAX,
+    init_state,
+    step,
+)
+
+
+def derive_fd_updates(grid: DagGrid) -> List[List[Tuple[int, int, int]]]:
+    """Reconstruct the per-event first-descendant write stream from a
+    completed grid: cell fd[row, c] == v was written by the insert of the
+    event (creator c, index v). O(E*N)."""
+    rows_by = np.full(
+        (grid.n, int(grid.index.max(initial=0)) + 1), -1, dtype=np.int32
+    )
+    if grid.e:
+        rows_by[grid.creator, grid.index] = np.arange(grid.e, dtype=np.int32)
+    stream: List[List[Tuple[int, int, int]]] = [[] for _ in range(grid.e)]
+    rows, cols = np.nonzero(grid.first_descendants != MAX_INT32)
+    vals = grid.first_descendants[rows, cols]
+    for row, c, v in zip(rows.tolist(), cols.tolist(), vals.tolist()):
+        updater = int(rows_by[c, v])
+        if updater != row:  # own-cell writes ride with the appended row
+            stream[updater].append((int(row), int(c), int(v)))
+    return stream
+
+
+class LiveDeviceEngine:
+    """Device-resident DAG state for one live Hashgraph."""
+
+    def __init__(self, hg, e_cap: int = 1 << 16, r_cap: int = 64,
+                 batch_cap: int = 64, upd_cap: int = 8192, e_win: int = 8192):
+        self.hg = hg
+        self.n = len(hg.participants.to_peer_slice())
+        self.e_cap = e_cap
+        self.r_cap = r_cap
+        self.batch_cap = batch_cap
+        self.upd_cap = upd_cap
+        self.e_win = min(e_win, e_cap)
+        self.state: IncState = init_state(self.n, e_cap, r_cap)
+        self.row_of: Dict[str, int] = {}
+        self.hashes: List[str] = []
+        self.pending: List[tuple] = []  # (event, fd_writes)
+        self._bootstrap()
+        hg.insert_listener = self._on_insert
+
+    # -- hashgraph hooks ---------------------------------------------------
+
+    def _on_insert(self, event, fd_writes) -> None:
+        """Called by Hashgraph.insert_event with the event and the
+        (ancestor_hash, creator_pos, index) first-descendant cells its
+        insert wrote."""
+        self.pending.append((event, fd_writes))
+
+    def detach(self) -> None:
+        if getattr(self.hg, "insert_listener", None) is self._on_insert:
+            self.hg.insert_listener = None
+
+    # -- construction ------------------------------------------------------
+
+    def _bootstrap(self) -> None:
+        """Replay the hashgraph's existing DAG into device state."""
+        grid = grid_from_hashgraph(self.hg)
+        if grid.e and not (
+            (grid.ext_sp_round == -1).all() and (grid.ext_op_round == -1).all()
+        ):
+            raise GridUnsupported("live incremental engine needs a base-state DAG")
+        if grid.e > self.e_cap:
+            raise GridUnsupported(f"DAG ({grid.e}) exceeds device capacity")
+        if grid.e > self.e_win:
+            # the first call writes back EVERY bootstrapped row, which must
+            # fit the fetch window — fail before paying for the replay
+            raise GridUnsupported(
+                f"DAG ({grid.e}) exceeds the write-back window ({self.e_win})"
+            )
+        self.hashes = list(grid.hashes)
+        self.row_of = {h: r for r, h in enumerate(self.hashes)}
+        if grid.e == 0:
+            return
+        import dataclasses
+
+        grid = dataclasses.replace(
+            grid, fd_update_stream=derive_fd_updates(grid)
+        )
+        from .incremental import batches_from_grid
+
+        for b in batches_from_grid(grid, self.batch_cap, self.upd_cap, self.e_cap):
+            self.state = step(
+                self.state, b, self.hg.super_majority, self.n,
+                e_win=self.e_win,
+            )
+
+    # -- advancing ---------------------------------------------------------
+
+    def advance(self) -> List[int]:
+        """Append all events inserted since the last call; returns their
+        device rows."""
+        if not self.pending:
+            return []
+        drained, self.pending = self.pending, []
+        new_rows: List[int] = []
+        if len(self.hashes) + len(drained) > self.e_cap:
+            raise GridUnsupported("device event capacity exhausted")
+
+        # greedy chunking: cap both the batch size and the within-batch
+        # dependency depth (a creator chaining deeply in one sync would
+        # otherwise exceed the level table — split instead of failing)
+        pos = 0
+        while pos < len(drained):
+            chunk = drained[pos : pos + self.batch_cap]
+            chunk = self._depth_cut(chunk)
+            pos += len(chunk)
+            batch, rows = self._build_batch(chunk)
+            self.state = step(
+                self.state, batch, self.hg.super_majority, self.n,
+                e_win=self.e_win,
+            )
+            new_rows.extend(rows)
+        return new_rows
+
+    def _depth_cut(self, chunk):
+        """Longest prefix of `chunk` whose within-chunk dependency depth
+        stays under the level-table height."""
+        depth: Dict[str, int] = {}
+        for k, (ev, _) in enumerate(chunk):
+            d = 0
+            for parent in (ev.self_parent(), ev.other_parent()):
+                if parent in depth:
+                    d = max(d, depth[parent] + 1)
+            if d >= L_MAX:
+                return chunk[:k]
+            depth[ev.hex()] = d
+        return chunk
+
+    def _build_batch(self, chunk) -> Tuple[Batch, List[int]]:
+        n, b_cap = self.n, self.batch_cap
+        b = len(chunk)
+        rows = []
+        creator = np.zeros(b_cap, dtype=np.int32)
+        index = np.full(b_cap, MAX_INT32, dtype=np.int32)
+        sp_row = np.full(b_cap, -1, dtype=np.int32)
+        op_row = np.full(b_cap, -1, dtype=np.int32)
+        la_rows = np.full((b_cap, n), -1, dtype=np.int32)
+        coin = np.zeros(b_cap, dtype=bool)
+        fixed_round = np.full(b_cap, -1, dtype=np.int32)
+        upd: List[Tuple[int, int, int]] = []
+
+        from ..hashgraph.hashgraph import middle_bit
+
+        for k, (ev, fd_writes) in enumerate(chunk):
+            row = len(self.hashes)
+            h = ev.hex()
+            self.row_of[h] = row
+            self.hashes.append(h)
+            rows.append(row)
+
+            creator[k] = self.hg.peer_position(ev.creator())
+            index[k] = ev.index()
+            sp = self.row_of.get(ev.self_parent(), -1)
+            op = self.row_of.get(ev.other_parent(), -1)
+            if sp < 0 and ev.index() != 0:
+                raise GridUnsupported("self-parent outside device state")
+            if op < 0 and ev.other_parent() != "":
+                raise GridUnsupported("other-parent outside device state")
+            if sp < 0 and ev.other_parent() == "":
+                # directly root-attached: round forced to the base root's
+                # next_round (reference: hashgraph.go:207-236); first
+                # events WITH an other-parent compute theirs normally
+                fixed_round[k] = 0
+            sp_row[k] = sp
+            op_row[k] = op
+            la_rows[k] = [c[0] for c in ev.last_ancestors]
+            coin[k] = middle_bit(h)
+            for ah, pos, val in fd_writes:
+                arow = self.row_of.get(ah)
+                if arow is None:
+                    raise GridUnsupported("fd update target outside device state")
+                upd.append((arow, pos, val))
+
+        if len(upd) > self.upd_cap:
+            raise GridUnsupported("fd update burst exceeds device staging")
+
+        # within-batch levels over batch-local dependencies
+        base_row = rows[0]
+        lvl = np.zeros(b, dtype=np.int64)
+        for k in range(b):
+            d = 0
+            for parent in (int(sp_row[k]), int(op_row[k])):
+                if parent >= base_row:
+                    d = max(d, lvl[parent - base_row] + 1)
+            lvl[k] = d
+        # caller (_depth_cut) guarantees depth < L_MAX
+        levels = np.full((L_MAX, b_cap), -1, dtype=np.int32)
+        slot = np.zeros(L_MAX, dtype=np.int64)
+        for k in range(b):
+            levels[lvl[k], slot[lvl[k]]] = k
+            slot[lvl[k]] += 1
+
+        urow = np.full(self.upd_cap, self.e_cap, dtype=np.int32)
+        ucol = np.zeros(self.upd_cap, dtype=np.int32)
+        uval = np.zeros(self.upd_cap, dtype=np.int32)
+        for k, (r, c, v) in enumerate(upd):
+            urow[k], ucol[k], uval[k] = r, c, v
+
+        brows = np.full(b_cap, -1, dtype=np.int32)
+        brows[:b] = rows
+        return (
+            Batch(
+                rows=brows, creator=creator, index=index,
+                sp_row=sp_row, op_row=op_row, la_rows=la_rows, coin=coin,
+                fixed_round=fixed_round,
+                upd_row=urow, upd_col=ucol, upd_val=uval, levels=levels,
+            ),
+            rows,
+        )
+
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def jnp_int32(x):
+    return jnp.int32(x)
+
+
+@functools.partial(jax.jit, static_argnames=("e_win", "r_cap", "n"))
+def _pack_results(st: IncState, lo, e_win: int, r_cap: int, n: int):
+    """Flatten everything the host write-back reads into ONE int32 vector
+    (a single transfer instead of nine round trips)."""
+    sl = lambda a: jax.lax.dynamic_slice(a, (lo,), (e_win,)).astype(jnp.int32)
+    return jnp.concatenate([
+        sl(st.rounds), sl(st.lamport),
+        sl(st.witness.astype(jnp.int32)), sl(st.received),
+        st.wtable.reshape(-1),
+        st.fame_decided.astype(jnp.int32).reshape(-1),
+        st.famous.astype(jnp.int32).reshape(-1),
+        jnp.stack([st.stale.astype(jnp.int32), st.fame_lag.astype(jnp.int32)]),
+    ])
+
+
+def _unpack_results(packed, e_win: int, r_cap: int, n: int):
+    o = 0
+    def take(sz, shape=None):
+        nonlocal o
+        part = packed[o : o + sz]
+        o += sz
+        return part if shape is None else part.reshape(shape)
+    rounds_w = take(e_win)
+    lamport_w = take(e_win)
+    witness_w = take(e_win).astype(bool)
+    received_w = take(e_win)
+    wtable = take(r_cap * n, (r_cap, n))
+    fame_decided = take(r_cap * n, (r_cap, n)).astype(bool)
+    famous = take(r_cap * n, (r_cap, n)).astype(bool)
+    flags = take(2)
+    return (rounds_w, lamport_w, witness_w, received_w, wtable,
+            fame_decided, famous, bool(flags[0]), bool(flags[1]))
+
+
+def run_consensus_live(hg) -> None:
+    """Incremental device consensus for a live node: advance the persistent
+    state by the events inserted since the last call, then write decisions
+    back and run the host passes (mirrors engine.run_consensus_device's
+    write-back, restricted to new/undetermined work)."""
+
+    from ..common import StoreErr, StoreErrType, is_store_err
+    from ..hashgraph import PendingRound, RoundInfo
+
+    eng: Optional[LiveDeviceEngine] = getattr(hg, "_live_device_engine", None)
+    if eng is None:
+        eng = LiveDeviceEngine(hg)
+        hg._live_device_engine = eng
+        # the bootstrap replayed the whole pre-existing DAG on device; its
+        # rows still need the host write-back below
+        new_rows = list(range(len(eng.hashes)))
+        new_rows.extend(eng.advance())
+    else:
+        new_rows = eng.advance()
+    st = eng.state
+
+    # ONE packed transfer of everything the write-back needs — per-array
+    # fetches each pay a full host<->device round trip
+    count = len(eng.hashes)
+    lo = max(count - eng.e_win, 0)
+    packed = jax.device_get(
+        _pack_results(st, jnp_int32(lo), eng.e_win, eng.r_cap, eng.n)
+    )
+    (rounds_w, lamport_w, witness_w, received_w, wtable, fame_decided,
+     famous, stale, fame_lag) = _unpack_results(packed, eng.e_win, eng.r_cap, eng.n)
+    rounds_w = rounds_w[: count - lo]
+    lamport_w = lamport_w[: count - lo]
+    witness_w = witness_w[: count - lo]
+    received_w = received_w[: count - lo]
+    if bool(stale) or bool(fame_lag):
+        eng.detach()
+        hg._live_device_engine = None
+        raise GridUnsupported(
+            "device window/unroll exhausted; rebuilding via one-shot path"
+        )
+
+    def at(row, arr):
+        if row < lo:
+            raise GridUnsupported("decision row below fetch window")
+        return arr[row - lo]
+
+    # --- DivideRounds write-back for the new events -----------------------
+    undetermined = set(hg.undetermined_events)
+    round_infos: Dict[int, RoundInfo] = {}
+    for row in new_rows:
+        h = eng.hashes[row]
+        ev = hg.store.get_event(h)
+        rnum = int(at(row, rounds_w))
+        ev.set_round(rnum)
+        ev.set_lamport_timestamp(int(at(row, lamport_w)))
+        hg.store.set_event(ev)
+        if h in undetermined:
+            ri = round_infos.get(rnum)
+            if ri is None:
+                try:
+                    ri = hg.store.get_round(rnum)
+                except StoreErr as err:
+                    if not is_store_err(err, StoreErrType.KEY_NOT_FOUND):
+                        raise
+                    ri = RoundInfo()
+                round_infos[rnum] = ri
+            if not ri.queued and (
+                hg.last_consensus_round is None
+                or rnum >= hg.last_consensus_round
+            ):
+                hg.pending_rounds.append(PendingRound(rnum, False))
+                ri.queued = True
+            ri.add_event(h, bool(at(row, witness_w)))
+
+    # --- DecideFame write-back (pending rounds only) ----------------------
+    decided_rounds = set()
+    for pr in hg.pending_rounds:
+        ri = round_infos.get(pr.index)
+        if ri is None:
+            ri = hg.store.get_round(pr.index)
+            round_infos[pr.index] = ri
+        if pr.index < eng.r_cap:
+            for c in range(eng.n):
+                wrow = int(wtable[pr.index, c])
+                if wrow < 0:
+                    continue
+                if fame_decided[pr.index, c]:
+                    ri.set_fame(eng.hashes[wrow], bool(famous[pr.index, c]))
+        if ri.witnesses_decided():
+            decided_rounds.add(pr.index)
+    for pr in hg.pending_rounds:
+        if pr.index in decided_rounds:
+            pr.decided = True
+
+    # --- DecideRoundReceived write-back (undetermined only) ---------------
+    new_undetermined = []
+    for h in hg.undetermined_events:
+        row = eng.row_of[h]
+        rr = int(at(row, received_w))
+        if rr >= 0:
+            ev = hg.store.get_event(h)
+            ev.set_round_received(rr)
+            hg.store.set_event(ev)
+            tri = round_infos.get(rr)
+            if tri is None:
+                tri = hg.store.get_round(rr)
+                round_infos[rr] = tri
+            tri.set_consensus_event(h)
+        else:
+            new_undetermined.append(h)
+    hg.undetermined_events = new_undetermined
+
+    for rnum, ri in round_infos.items():
+        hg.store.set_round(rnum, ri)
+
+    # --- host passes 4-5 --------------------------------------------------
+    hg.process_decided_rounds()
+    hg.process_sig_pool()

@@ -74,15 +74,20 @@ def build_cluster(n, conf, store_factory=None):
 
 
 def first_available_block(node, upto):
-    """A fast-forwarded node starts mid-history; find the first block it
-    actually holds."""
-    for i in range(upto + 1):
+    """A fast-forwarded node starts mid-history — and a node that
+    fast-forwarded more than once can hold disjoint ranges. Return the
+    start of the contiguous block range ending at `upto` (the range the
+    byte-equality check can walk)."""
+    start = None
+    for i in range(upto, -1, -1):
         try:
             node.get_block(i)
-            return i
+            start = i
         except Exception:  # noqa: BLE001
-            continue
-    raise AssertionError("node holds no blocks at all")
+            break
+    if start is None:
+        raise AssertionError(f"node holds no blocks at or below {upto}")
+    return start
 
 
 def connect_transport(transports, new_trans):
@@ -138,9 +143,12 @@ def test_catch_up():
         node4.run_async(True)
         bombard_and_wait(nodes, proxies, target_block=target + 2, timeout_s=180)
         # node4 joined mid-history: its first block came from a frame,
-        # and from there on bodies must be byte-identical
-        start = first_available_block(node4, target + 2)
-        check_gossip(nodes, from_block=start, upto=target + 2)
+        # and from there on bodies must be byte-identical (compare over
+        # the committed range every node shares — the joiner's anchor may
+        # sit above the original target if the others raced ahead)
+        upto = min(n.core.get_last_block_index() for n in nodes)
+        start = first_available_block(node4, upto)
+        check_gossip(nodes, from_block=start, upto=upto)
     finally:
         shutdown_nodes(nodes)
 
@@ -195,8 +203,9 @@ def test_fast_sync_repeated():
             # fast-forward attempts while the survivors keep racing ahead
             goal = base + 5
             bombard_and_wait(nodes, proxies, target_block=goal, timeout_s=240)
-            start = first_available_block(node, goal)
-            check_gossip(nodes, from_block=start, upto=goal)
+            upto = min(n.core.get_last_block_index() for n in nodes)
+            start = first_available_block(node, upto)
+            check_gossip(nodes, from_block=start, upto=upto)
     finally:
         shutdown_nodes(nodes)
 
