@@ -184,7 +184,12 @@ class Node(NodeStateMachine):
                         lambda addr=peer.net_addr: self._gossip(addr, return_event),
                         name=f"node-{self.id}-gossip",
                     )
-            if not self.core.need_gossip():
+            # keep ticking while starting: a fresh joiner has nothing to
+            # gossip about (need_gossip False) but must retry its first
+            # exchange until one peer answers — stopping the timer here
+            # would strand it if that first attempt hit a dead peer
+            # (the reference's timer free-runs, node.go:180-204)
+            if not (self.core.need_gossip() or self.is_starting()):
                 self.control_timer.stop()
             elif not self.control_timer.set:
                 self.control_timer.reset()

@@ -33,7 +33,7 @@ def make_config(sync_limit=150):
     )
 
 
-def build_cluster(n, conf, store_factory=None):
+def build_cluster(n, conf, store_factory=None, proxy_factory=None):
     """Like test_node.init_nodes but keeps keys so nodes can be recycled
     (reference: node_test.go:292-388)."""
     keys = [generate_key() for _ in range(n)]
@@ -62,7 +62,7 @@ def build_cluster(n, conf, store_factory=None):
             if store_factory
             else InmemStore(participants, conf.cache_size)
         )
-        prox = InmemDummyClient()
+        prox = proxy_factory(i) if proxy_factory else InmemDummyClient()
         node = Node(
             copy.copy(conf), peer_list[i].id, key, participants, store,
             transports[i], prox,

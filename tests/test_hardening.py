@@ -1,0 +1,243 @@
+"""Adversarial/robustness tests for the fast-sync and transport hardening:
+
+- oversized frames from unauthenticated peers are rejected without taking
+  the listener down (tcp_transport max_frame_size);
+- a forged app snapshot from a malicious donor cannot leave the joiner's
+  app on foreign state (the anchor block's >1/3-signed state hash gates
+  the restore, node.fast_forward);
+- chained fast-sync: a joiner can fast-forward FROM a donor that itself
+  fast-synced (the donor's section forwards FrozenRefs for other-parents
+  it only knows as refs — reference scenario: src/node/node_test.go:583
+  extended with a forced second-generation donor).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+from babble_tpu.hashgraph import InmemStore
+from babble_tpu.net import (
+    InmemTransport,
+    SyncRequest,
+    SyncResponse,
+    TCPTransport,
+)
+from babble_tpu.node import Node
+from babble_tpu.node.state import NodeState
+from babble_tpu.proxy import InmemDummyClient
+
+from test_fastsync import (
+    build_cluster,
+    first_available_block,
+    make_config,
+)
+from test_node import (
+    bombard_and_wait,
+    check_gossip,
+    run_nodes,
+    shutdown_nodes,
+)
+
+
+def test_tcp_oversized_frame_rejected():
+    """A frame larger than max_frame_size must be refused before buffering
+    and must not take down the accept loop (ADVICE r1: unbounded frames
+    from unauthenticated peers)."""
+    server = TCPTransport("127.0.0.1:0", max_frame_size=4096)
+    try:
+        host, port = server.local_addr().split(":")
+
+        # responder for the one legitimate RPC sent below
+        def respond():
+            rpc = server.consumer().get(timeout=5)
+            rpc.respond(SyncResponse(from_id=1, sync_limit=True,
+                                     events=[], known={}))
+
+        t = threading.Thread(target=respond, daemon=True)
+        t.start()
+
+        # oversized frame: header claims 1 MiB body. The server may reset
+        # the connection at any point after reading the header, so the
+        # body send races the close — both outcomes are the rejection
+        # under test.
+        bad = socket.create_connection((host, int(port)), timeout=2)
+        bad.settimeout(2)
+        try:
+            bad.sendall(struct.pack(">BI", 0, 1 << 20))
+            bad.sendall(b"x" * 65536)  # partial body; server should hang up
+            data = bad.recv(1)
+            assert data == b"", "server should close the connection"
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            bad.close()
+
+        # the listener must still serve normal requests
+        client = TCPTransport("127.0.0.1:0", max_frame_size=4096)
+        try:
+            resp = client.sync(
+                server.local_addr(), SyncRequest(from_id=0, known={})
+            )
+            assert resp.sync_limit is True
+        finally:
+            client.close()
+    finally:
+        server.close()
+
+
+class ForgingDummyClient(InmemDummyClient):
+    """Dummy app whose snapshots can be switched to forgeries — the
+    malicious-donor side of the fast-forward handshake."""
+
+    def __init__(self):
+        super().__init__()
+        self.forge = False
+
+    def get_snapshot(self, block_index: int) -> bytes:
+        if self.forge:
+            return b'{"forged": true}'
+        return super().get_snapshot(block_index)
+
+
+def test_fast_forward_rejects_forged_snapshot():
+    """While every reachable donor forges snapshots, a joiner must refuse
+    to leave CatchingUp (the restored state hash cannot reproduce the
+    anchor block's signed state hash); once a donor turns honest the
+    joiner must catch up with byte-identical blocks."""
+    conf = make_config()
+
+    nodes, proxies, keys, peer_list, participants, transports = build_cluster(
+        4, conf, proxy_factory=lambda i: ForgingDummyClient()
+    )
+    node4, prox4 = nodes[3], proxies[3]
+    nodes3, proxies3 = nodes[:3], proxies[:3]
+    try:
+        run_nodes(nodes3)
+        target = 3
+        while True:
+            bombard_and_wait(nodes3, proxies3, target_block=target,
+                             timeout_s=180)
+            total_events = sum(
+                i + 1 for i in nodes3[0].core.known_events().values()
+            )
+            if total_events > conf.sync_limit + 50:
+                break
+            target += 1
+
+        # all donors forge
+        for p in proxies3:
+            p.forge = True
+        node4.run_async(True)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            assert node4.core.get_last_block_index() < 0, (
+                "joiner committed blocks from a forged snapshot"
+            )
+            time.sleep(0.25)
+        assert node4.get_state() == NodeState.CATCHING_UP
+
+        # donors turn honest; the joiner must now catch up for real
+        for p in proxies3:
+            p.forge = False
+        target = max(n.core.get_last_block_index() for n in nodes3) + 2
+        bombard_and_wait(nodes, proxies, target_block=target, timeout_s=180)
+        upto = min(n.core.get_last_block_index() for n in nodes)
+        start = first_available_block(node4, upto)
+        check_gossip(nodes, from_block=start, upto=upto)
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_chained_fast_sync_donor():
+    """Second-generation fast-sync: node D joins via fast-forward; later
+    node C rejoins with connectivity ONLY to D, so D — itself a product of
+    fast-sync — must serve the anchor + section (forwarding FrozenRefs for
+    other-parents it never held as events; ADVICE r1 item 1)."""
+    conf = make_config()
+    nodes, proxies, keys, peer_list, participants, transports = build_cluster(
+        4, conf
+    )
+    try:
+        # phase 1: run 0-2 past the sync limit, then start 3 -> fast-sync
+        nodes3, proxies3 = nodes[:3], proxies[:3]
+        run_nodes(nodes3)
+        target = 3
+        while True:
+            bombard_and_wait(nodes3, proxies3, target_block=target,
+                             timeout_s=180)
+            total_events = sum(
+                i + 1 for i in nodes3[0].core.known_events().values()
+            )
+            if total_events > conf.sync_limit + 50:
+                break
+            target += 1
+        nodes[3].run_async(True)
+        target = max(n.core.get_last_block_index() for n in nodes[:3]) + 2
+        bombard_and_wait(nodes, proxies, target_block=target, timeout_s=240)
+        upto3 = min(n.core.get_last_block_index() for n in nodes)
+        assert first_available_block(nodes[3], upto3) > 0, (
+            "node 3 should have joined mid-history (fast-sync), not replayed"
+        )
+
+        # phase 2: kill node 2, run the rest past the sync limit again
+        victim_addr = peer_list[2].net_addr
+        nodes[2].shutdown()
+        transports[2].disconnect_all()
+        for t in (transports[0], transports[1], transports[3]):
+            t.disconnect(victim_addr)
+        alive = [nodes[0], nodes[1], nodes[3]]
+        alive_prox = [proxies[0], proxies[1], proxies[3]]
+        goal = max(n.core.get_last_block_index() for n in alive) + 3
+        while True:
+            bombard_and_wait(alive, alive_prox, target_block=goal,
+                             timeout_s=240)
+            total_events = sum(
+                i + 1 for i in nodes[0].core.known_events().values()
+            )
+            if total_events > conf.sync_limit + 50:
+                break
+            goal += 1
+
+        # phase 3: recycle node 2 connected ONLY to node 3 (the
+        # fast-synced node) -> its fast-forward donor must be node 3
+        trans = InmemTransport(victim_addr, timeout=5.0)
+        trans.connect(transports[3].local_addr(), transports[3])
+        transports[3].connect(victim_addr, trans)
+        transports[2] = trans
+        prox = InmemDummyClient()
+        store = InmemStore(participants, conf.cache_size)
+        import copy as _copy
+
+        node = Node(
+            _copy.copy(conf), peer_list[2].id, keys[2], participants, store,
+            trans, prox,
+        )
+        node.init()
+        nodes[2] = node
+        proxies[2] = prox
+        node.run_async(True)
+
+        # the joiner must catch up THROUGH node 3 alone
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if node.core.get_last_block_index() >= goal - 1:
+                break
+            time.sleep(0.5)
+        assert node.core.get_last_block_index() >= goal - 1, (
+            "joiner failed to fast-sync from a donor that itself fast-synced"
+        )
+        assert first_available_block(node, node.core.get_last_block_index()) > 0
+
+        # reconnect the full mesh and verify convergence
+        for t in (transports[0], transports[1]):
+            t.connect(victim_addr, trans)
+            trans.connect(t.local_addr(), t)
+        upto = min(n.core.get_last_block_index() for n in nodes)
+        start = max(
+            first_available_block(nodes[2], upto),
+            first_available_block(nodes[3], upto),
+        )
+        check_gossip(nodes, from_block=start, upto=upto)
+    finally:
+        shutdown_nodes(nodes)

@@ -13,8 +13,12 @@ from babble_tpu.tpu.incremental import (
     batches_from_grid,
     init_state,
     multi_step,
+    multi_train,
     stack_batches,
+    stack_trains,
     step,
+    train_step,
+    trains_from_grid,
 )
 
 
@@ -55,6 +59,60 @@ def test_multi_step_matches_per_batch():
             many, stack_batches(batches[i : i + k]),
             grid.super_majority, n, e_win=512,
         )
+
+    for f in ("rounds", "lamport", "witness", "received"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(one, f)), np.asarray(getattr(many, f)), f
+        )
+    assert not bool(many.stale) and not bool(many.fame_lag)
+
+
+@pytest.mark.parametrize("zipf", [0.0, 1.1])
+def test_train_matches_per_batch(zipf):
+    """The flattened-train program (MXU one-hot gathers, bulk post-scan
+    registration) must reproduce the per-batch path bit-exactly across
+    every decision array."""
+    n, e = 8, 768
+    grid = synthetic_grid(n, e, seed=3, zipf_a=zipf, record_fd_updates=True)
+
+    ref = init_state(n, e, 64)
+    for b in batches_from_grid(grid, 32, 8192, e):
+        ref = step(ref, b, grid.super_majority, n, e_win=512)
+
+    tr = init_state(n, e, 64)
+    for t in trains_from_grid(grid, 256, 8192, e, w_cap=16, t_cap=96):
+        tr = train_step(tr, t, grid.super_majority, n, e_win=512)
+
+    assert not bool(tr.stale) and not bool(tr.fame_lag)
+    for f in ("rounds", "lamport", "witness", "received", "wtable",
+              "fame_decided", "famous", "rounds_decided"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(tr, f)), f
+        )
+    assert int(tr.last_round) == int(ref.last_round)
+
+
+def test_multi_train_matches_train():
+    """K stacked trains per dispatch must equal per-train dispatch."""
+    n, e = 8, 512
+    grid = synthetic_grid(n, e, seed=5, zipf_a=1.1, record_fd_updates=True)
+    trains = trains_from_grid(grid, 128, 8192, e, w_cap=16, t_cap=64)
+
+    one = init_state(n, e, 64)
+    for t in trains:
+        one = train_step(one, t, grid.super_majority, n, e_win=512)
+
+    k = 2
+    many = init_state(n, e, 64)
+    for i in range(0, len(trains), k):
+        group = trains[i : i + k]
+        if len(group) < k:
+            for t in group:
+                many = train_step(many, t, grid.super_majority, n, e_win=512)
+        else:
+            many = multi_train(
+                many, stack_trains(group), grid.super_majority, n, e_win=512
+            )
 
     for f in ("rounds", "lamport", "witness", "received"):
         np.testing.assert_array_equal(
