@@ -839,6 +839,18 @@ def trains_from_grid(grid: DagGrid, train_size: int, upd_cap: int,
         # global (train-wide) dependency levels
         lvl = _dep_levels(sp_pos, op_pos)
         table = _pack_levels(lvl, w_cap)
+        # the device program's unique_indices promises rest on one creator
+        # per level row (guaranteed fork-free: same-creator events chain
+        # through self-parents into deeper levels) — refuse forked input
+        # rather than hand XLA undefined scatter behavior
+        for row in table:
+            members = row[row >= 0]
+            cs = grid.creator[rows[members]]
+            if len(np.unique(cs)) != len(cs):
+                raise ValueError(
+                    "forked creator within a dependency level; "
+                    "train path requires fork-free grids"
+                )
         upd = [t for r in rows for t in grid.fd_update_stream[r]]
         if table.shape[0] > t_cap or len(upd) > upd_cap:
             if b <= 1:

@@ -1,0 +1,95 @@
+"""Block-signature pool tests: signatures ride in events, accumulate in
+the sig pool at insert, and ProcessSigPool attaches the valid ones to
+stored blocks (reference: src/hashgraph/hashgraph_test.go:954-1109
+TestInsertEventsWithBlockSignatures + initBlockHashgraph)."""
+
+from babble_tpu import crypto
+from babble_tpu.hashgraph import (
+    Block,
+    BlockSignature,
+    Event,
+    Hashgraph,
+    InmemStore,
+    root_self_parent,
+)
+
+from dsl import CACHE_SIZE, Play, init_hashgraph_nodes, play_events
+
+
+def init_block_hashgraph():
+    """Three root-attached events + a manually stored block 0
+    (reference: hashgraph_test.go:954-978)."""
+    nodes, index, ordered, participants = init_hashgraph_nodes(3)
+    for i, peer in enumerate(participants.to_peer_slice()):
+        ev = Event(
+            parents=[root_self_parent(peer.id), ""],
+            creator=nodes[i].pub, index=0,
+        )
+        nodes[i].sign_and_add_event(ev, f"e{i}", index, ordered)
+
+    h = Hashgraph(participants, InmemStore(participants, CACHE_SIZE))
+    block = Block(0, 1, b"framehash", [b"block tx"])
+    h.store.set_block(block)
+    for ev in ordered:
+        h.insert_event(ev, True)
+    return h, nodes, index, ordered
+
+
+def test_insert_events_with_block_signatures():
+    h, nodes, index, ordered = init_block_hashgraph()
+    block = h.store.get_block(0)
+    block_sigs = [block.sign(n.key) for n in nodes]
+
+    # --- valid signatures ride in events and attach to block 0 ----------
+    plays = [
+        Play(1, 1, "e1", "e0", "e10", None, [block_sigs[1]]),
+        Play(2, 1, "e2", "", "s20", None, [block_sigs[2]]),
+        Play(0, 1, "e0", "", "s00", None, [block_sigs[0]]),
+    ]
+    play_events(plays, nodes, index, ordered)
+    for ev in ordered[3:]:
+        h.insert_event(ev, True)
+
+    assert len(h.sig_pool) == 3
+    h.process_sig_pool()
+    assert len(h.store.get_block(0).signatures) == 3
+    assert len(h.sig_pool) == 0
+
+    # --- signature of an unknown block: event inserted, sig kept pending
+    block1 = Block(1, 2, b"framehash", [])
+    sig1 = block1.sign(nodes[2].key)
+    unknown = BlockSignature(
+        validator=nodes[2].pub, index=1, signature=sig1.signature
+    )
+    p = Play(2, 2, "s20", "e10", "e21", None, [unknown])
+    play_events([p], nodes, index, ordered)
+    h.insert_event(ordered[-1], True)
+    h.store.get_event(index["e21"])  # recorded
+    h.process_sig_pool()
+    # the block is unknown, so the signature stays pending for later
+    assert len(h.sig_pool) == 1
+    assert len(h.store.get_block(0).signatures) == 3
+
+    # --- signature from a non-participant validator: ignored ------------
+    bad_key = crypto.generate_key()
+    bad_sig = h.store.get_block(0).sign(bad_key)
+    p = Play(0, 2, "s00", "e21", "e02", None, [bad_sig])
+    play_events([p], nodes, index, ordered)
+    h.insert_event(ordered[-1], True)
+    h.store.get_event(index["e02"])  # recorded
+    h.process_sig_pool()
+    assert len(h.store.get_block(0).signatures) == 3
+
+    # --- tampered signature from a real participant: rejected -----------
+    forged = BlockSignature(
+        validator=nodes[1].pub, index=0,
+        signature=block_sigs[0].signature,  # node0's sig, node1's identity
+    )
+    h.sig_pool.append(forged)
+    h.process_sig_pool()
+    block0 = h.store.get_block(0)
+    assert len(block0.signatures) == 3
+    for n in nodes:
+        assert block0.verify(block0.get_signature(
+            "0x" + n.pub.hex().upper()
+        ))
