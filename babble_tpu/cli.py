@@ -88,13 +88,40 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _merge_config_file(args: argparse.Namespace) -> None:
-    """Config-file values fill in anything left at its default
-    (flags win, like the reference's viper binding, run.go:93-127)."""
+_SENTINEL = object()
+
+
+def _explicit_attrs(argv) -> set:
+    """Which run-command dests the user actually passed on the command
+    line. Detected by re-parsing with every default swapped for a
+    sentinel — argparse itself then accounts for glued short options
+    (-t5), '=' forms, and prefix abbreviations (--heart 2)."""
+    p = build_parser()
+    sub = next(
+        a for a in p._actions if isinstance(a, argparse._SubParsersAction)
+    )
+    for act in sub.choices["run"]._actions:
+        if act.dest != "help":
+            act.default = _SENTINEL
+    ns = p.parse_args(argv)
+    return {
+        k for k, v in vars(ns).items()
+        if v is not _SENTINEL and k != "command"
+    }
+
+
+def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
+    """Config-file values fill in anything the user did not pass
+    explicitly (flags win, like the reference's viper binding,
+    run.go:93-127). Explicitness is detected by argparse itself, not by
+    comparing against defaults — a flag explicitly set TO its default
+    must still beat the file."""
     cfg = _load_config_file(args.datadir)
     if not cfg:
         return
-    defaults = build_parser().parse_args(["run"])
+    argv = list(sys.argv[1:] if argv is None else argv)
+    explicit = _explicit_attrs(argv)
+
     mapping = {
         "log": "log", "listen": "listen", "timeout": "timeout",
         "max-pool": "max_pool", "standalone": "standalone",
@@ -104,7 +131,7 @@ def _merge_config_file(args: argparse.Namespace) -> None:
         "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
     }
     for file_key, attr in mapping.items():
-        if file_key in cfg and getattr(args, attr) == getattr(defaults, attr):
+        if file_key in cfg and attr not in explicit:
             setattr(args, attr, cfg[file_key])
 
 
@@ -172,7 +199,7 @@ def keygen_command(args: argparse.Namespace) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "run":
-        _merge_config_file(args)
+        _merge_config_file(args, argv)
         return run_command(args)
     if args.command == "keygen":
         return keygen_command(args)

@@ -34,6 +34,8 @@ from .incremental import (
     IncState,
     L_MAX,
     init_state,
+    multi_step,
+    stack_batches,
     step,
 )
 
@@ -126,7 +128,14 @@ class LiveDeviceEngine:
 
     def advance(self) -> List[int]:
         """Append all events inserted since the last call; returns their
-        device rows."""
+        device rows.
+
+        Hybrid dispatch: a normal gossip sync stages 1-2 batches and goes
+        through the straight-line ``step`` program (cheapest per small
+        append); a catch-up burst (3+ batches) is stacked into
+        ``multi_step`` trains — one device program per up to 16 batches —
+        padded with no-op batches to two fixed shapes (K=4/K=16) so the
+        live path compiles at most three programs."""
         if not self.pending:
             return []
         drained, self.pending = self.pending, []
@@ -137,18 +146,56 @@ class LiveDeviceEngine:
         # greedy chunking: cap both the batch size and the within-batch
         # dependency depth (a creator chaining deeply in one sync would
         # otherwise exceed the level table — split instead of failing)
+        built: List[Batch] = []
         pos = 0
         while pos < len(drained):
             chunk = drained[pos : pos + self.batch_cap]
             chunk = self._depth_cut(chunk)
             pos += len(chunk)
             batch, rows = self._build_batch(chunk)
-            self.state = step(
-                self.state, batch, self.hg.super_majority, self.n,
-                e_win=self.e_win,
-            )
+            built.append(batch)
             new_rows.extend(rows)
+
+        if len(built) <= 2:
+            for b in built:
+                self.state = step(
+                    self.state, b, self.hg.super_majority, self.n,
+                    e_win=self.e_win,
+                )
+        else:
+            for i in range(0, len(built), 16):
+                group = built[i : i + 16]
+                k = 4 if len(group) <= 4 else 16
+                group = group + [self._empty_batch()] * (k - len(group))
+                self.state = multi_step(
+                    self.state, stack_batches(group),
+                    self.hg.super_majority, self.n, e_win=self.e_win,
+                )
         return new_rows
+
+    def _empty_batch(self) -> Batch:
+        """A no-op Batch (every scatter drops) for padding multi_step
+        groups to their fixed stack shapes."""
+        cached = getattr(self, "_empty_batch_cache", None)
+        if cached is not None:
+            return cached
+        n, b_cap = self.n, self.batch_cap
+        b = Batch(
+            rows=np.full(b_cap, -1, dtype=np.int32),
+            creator=np.zeros(b_cap, dtype=np.int32),
+            index=np.full(b_cap, MAX_INT32, dtype=np.int32),
+            sp_row=np.full(b_cap, -1, dtype=np.int32),
+            op_row=np.full(b_cap, -1, dtype=np.int32),
+            la_rows=np.full((b_cap, n), -1, dtype=np.int32),
+            coin=np.zeros(b_cap, dtype=bool),
+            fixed_round=np.full(b_cap, -1, dtype=np.int32),
+            upd_row=np.full(self.upd_cap, self.e_cap, dtype=np.int32),
+            upd_col=np.zeros(self.upd_cap, dtype=np.int32),
+            upd_val=np.zeros(self.upd_cap, dtype=np.int32),
+            levels=np.full((L_MAX, b_cap), -1, dtype=np.int32),
+        )
+        self._empty_batch_cache = b
+        return b
 
     def _depth_cut(self, chunk):
         """Longest prefix of `chunk` whose within-chunk dependency depth

@@ -1,0 +1,104 @@
+"""HTTP status service and CLI tests: /stats and /block over a live
+node (reference: src/service/service.go:28-63), keygen datadir output
+(cmd/babble/commands/keygen.go), and the flag/config-file merge
+precedence (run.go:93-155)."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+from babble_tpu.cli import _merge_config_file, build_parser, keygen_command
+from babble_tpu.service import Service
+
+from test_node import bombard_and_wait, init_nodes, run_nodes, shutdown_nodes
+
+REFERENCE_STAT_KEYS = {
+    "last_consensus_round", "last_block_index", "consensus_events",
+    "consensus_transactions", "undetermined_events", "transaction_pool",
+    "num_peers", "sync_rate", "events_per_second", "rounds_per_second",
+    "round_events", "id", "state",
+}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_service_stats_and_block():
+    nodes, proxies = init_nodes(4)
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        run_nodes(nodes)
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+
+        stats = _get(base + "/stats")
+        # parity: every reference metric present (node.go:660-695), plus
+        # the backend extensions
+        assert REFERENCE_STAT_KEYS <= set(stats)
+        assert stats["consensus_backend"] in ("cpu", "tpu")
+        assert stats["num_peers"] == "4"
+
+        bombard_and_wait(nodes, proxies, target_block=1)
+        blk = _get(base + "/block/0")
+        assert blk["Body"]["Index"] == 0
+        assert isinstance(blk["Body"]["Transactions"], list)
+
+        # missing block -> HTTP error, service stays up
+        try:
+            _get(base + "/block/99999")
+            raise AssertionError("expected HTTP error for missing block")
+        except urllib.error.HTTPError as e:
+            assert e.code in (404, 500)
+        assert _get(base + "/stats")["num_peers"] == "4"
+    finally:
+        svc.shutdown()
+        shutdown_nodes(nodes)
+
+
+def test_keygen_writes_pem(tmp_path):
+    class Args:
+        datadir = str(tmp_path)
+
+    assert keygen_command(Args()) == 0
+    pem = os.path.join(str(tmp_path), "priv_key.pem")
+    assert os.path.exists(pem)
+    assert b"EC PRIVATE KEY" in open(pem, "rb").read()
+    # refuses to overwrite an existing key
+    assert keygen_command(Args()) == 1
+
+
+def test_config_file_merge_flags_win(tmp_path):
+    (tmp_path / "babble.json").write_text(json.dumps({
+        "heartbeat": 0.25,
+        "sync-limit": 42,
+        "consensus-backend": "tpu",
+    }))
+    # file fills defaults...
+    argv = ["run", "--datadir", str(tmp_path)]
+    args = build_parser().parse_args(argv)
+    _merge_config_file(args, argv)
+    assert args.heartbeat == 0.25
+    assert args.sync_limit == 42
+    assert args.consensus_backend == "tpu"
+    # ...but explicit flags win over the file
+    argv = ["run", "--datadir", str(tmp_path), "--heartbeat", "0.5",
+            "--consensus-backend", "cpu"]
+    args = build_parser().parse_args(argv)
+    _merge_config_file(args, argv)
+    assert args.heartbeat == 0.5
+    assert args.consensus_backend == "cpu"
+    assert args.sync_limit == 42  # still from the file
+
+    # argparse's glued short options and prefix abbreviations also count
+    # as explicit (argparse itself does the accounting)
+    (tmp_path / "babble.json").write_text(json.dumps({
+        "timeout": 3.0, "heartbeat": 9.0,
+    }))
+    argv = ["run", "--datadir", str(tmp_path), "-t5", "--heart", "2"]
+    args = build_parser().parse_args(argv)
+    _merge_config_file(args, argv)
+    assert args.timeout == 5.0
+    assert args.heartbeat == 2.0
