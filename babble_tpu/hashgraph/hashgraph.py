@@ -1193,3 +1193,13 @@ class Hashgraph:
         self.last_consensus_round = i
         if self.first_consensus_round is None:
             self.first_consensus_round = i
+        # "number of events in round before LastConsensusRound" — declared
+        # but never maintained in the reference (hashgraph.go:27 is its
+        # only non-getter mention, so its round_events stat is always 0);
+        # here the stat is actually kept
+        try:
+            self.last_committed_round_events = len(
+                self.store.get_round(i - 1).round_events()
+            )
+        except StoreErr:
+            self.last_committed_round_events = 0

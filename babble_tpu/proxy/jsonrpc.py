@@ -21,6 +21,12 @@ class JSONRPCError(Exception):
     pass
 
 
+# one request/response line: block commits and app snapshots ride these,
+# so generous — but bounded, like the gossip transport's frame cap
+# (net/tcp_transport.py DEFAULT_MAX_FRAME)
+DEFAULT_MAX_LINE = 64 << 20
+
+
 class JSONRPCClient:
     """One persistent connection, serialized calls."""
 
@@ -87,7 +93,8 @@ class JSONRPCServer:
     result; exceptions become the response's error string.
     """
 
-    def __init__(self, bind_addr: str):
+    def __init__(self, bind_addr: str, max_line: int = DEFAULT_MAX_LINE,
+                 max_inbound: int = 64):
         host, port = split_hostport(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -95,6 +102,8 @@ class JSONRPCServer:
         self._listener.listen(16)
         lhost, lport = self._listener.getsockname()
         self.addr = f"{lhost}:{lport}"
+        self.max_line = max_line
+        self._conn_slots = threading.BoundedSemaphore(max_inbound)
         self._handlers: Dict[str, Callable[[Any], Any]] = {}
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
@@ -113,6 +122,13 @@ class JSONRPCServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 return
+            if not self._conn_slots.acquire(blocking=False):
+                # inbound cap: refuse rather than grow a thread per dial
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             threading.Thread(
                 target=self._serve_conn, args=(sock,), daemon=True
             ).start()
@@ -121,10 +137,18 @@ class JSONRPCServer:
         try:
             rfile = sock.makefile("rb")
             while not self._shutdown.is_set():
-                line = rfile.readline()
+                line = rfile.readline(self.max_line + 1)
                 if not line:
                     return
+                if len(line) > self.max_line:
+                    # oversized request line: hang up before buffering more
+                    return
                 req = json.loads(line)
+                if not isinstance(req, dict) or not isinstance(
+                    req.get("method", ""), str
+                ):
+                    # malformed-but-valid JSON: hang up, don't guess
+                    return
                 rid = req.get("id")
                 handler = self._handlers.get(req.get("method", ""))
                 if handler is None:
@@ -147,6 +171,7 @@ class JSONRPCServer:
         except (OSError, json.JSONDecodeError):
             pass
         finally:
+            self._conn_slots.release()
             try:
                 sock.close()
             except OSError:

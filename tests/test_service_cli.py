@@ -46,6 +46,12 @@ def test_service_stats_and_block():
         assert blk["Body"]["Index"] == 0
         assert isinstance(blk["Body"]["Transactions"], list)
 
+        # round_events is actually maintained here (the reference declares
+        # but never updates it): events in the round before the last
+        # consensus round
+        stats = _get(base + "/stats")
+        assert int(stats["round_events"]) > 0
+
         # missing block -> HTTP error, service stays up
         try:
             _get(base + "/block/99999")
