@@ -21,6 +21,12 @@ class Frame:
     round: int = -1  # the round received
     roots: List[Root] = field(default_factory=list)  # [peer position] => Root
     events: List[Event] = field(default_factory=list)
+    # frozen on first computation: a frame is immutable once built (it is
+    # stored and pinned into block headers), and the canonical marshal of
+    # every contained event is expensive enough to dominate block
+    # construction if recomputed (new_block_from_frame + the store both
+    # ask for the hash)
+    _hash: bytes = field(default=b"", repr=False, compare=False)
 
     def to_canonical(self) -> dict:
         return {
@@ -33,7 +39,9 @@ class Frame:
         return canonical_dumps(self.to_canonical())
 
     def hash(self) -> bytes:
-        return crypto.sha256(self.marshal())
+        if not self._hash:
+            self._hash = crypto.sha256(self.marshal())
+        return self._hash
 
     def to_json(self) -> dict:
         return {

@@ -27,12 +27,27 @@ class JSONRPCError(Exception):
 DEFAULT_MAX_LINE = 64 << 20
 
 
+def _read_bounded_line(rfile, max_line: int) -> Optional[bytes]:
+    """One newline-terminated line of payload <= max_line bytes, or None
+    when the stream closed / the line is over the limit (the caller hangs
+    up — never buffer an unbounded line). The single home of the boundary
+    arithmetic for both the client and the server."""
+    line = rfile.readline(max_line + 2)
+    if not line:
+        return None
+    if not line.endswith(b"\n") or len(line) > max_line + 1:
+        return None
+    return line
+
+
 class JSONRPCClient:
     """One persistent connection, serialized calls."""
 
-    def __init__(self, addr: str, timeout: float = 5.0):
+    def __init__(self, addr: str, timeout: float = 5.0,
+                 max_line: Optional[int] = None):
         self.addr = addr
         self.timeout = timeout
+        self.max_line = DEFAULT_MAX_LINE if max_line is None else max_line
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._next_id = 0
@@ -46,31 +61,52 @@ class JSONRPCClient:
 
     def call(self, method: str, param: Any) -> Any:
         with self._lock:
-            if self._sock is None:
+            # one transparent retry: a server that recycled our idle
+            # connection (JSONRPCServer.idle_timeout) surfaces as a dead
+            # socket on the next call — reconnect once rather than drop
+            # the request
+            for attempt in (0, 1):
+                if self._sock is None:
+                    try:
+                        self._connect()
+                    except OSError as exc:
+                        self.close_locked()
+                        raise JSONRPCError(
+                            f"connect to {self.addr}: {exc}"
+                        ) from exc
+                self._next_id += 1
+                msg = json.dumps(
+                    {"method": method, "params": [param], "id": self._next_id}
+                ).encode() + b"\n"
                 try:
-                    self._connect()
-                except OSError as exc:
+                    self._sock.sendall(msg)
+                    line = self._rfile.readline(self.max_line + 2)
+                    if not line:
+                        raise ConnectionError("connection closed")
+                except (OSError, AttributeError) as exc:
+                    self.close_locked()
+                    # retry ONLY the recycled-connection signature: the
+                    # server hung up without replying (ConnectionError).
+                    # A timeout means the request may still be executing —
+                    # resending would double-execute a non-idempotent call
+                    # (TimeoutError subclasses OSError, not
+                    # ConnectionError, so it lands in the raise)
+                    if attempt == 0 and isinstance(exc, ConnectionError):
+                        continue
+                    raise JSONRPCError(
+                        f"rpc {method} to {self.addr}: {exc}"
+                    ) from exc
+                if not line.endswith(b"\n") or len(line) > self.max_line + 1:
+                    # bounded read: a server streaming an endless response
+                    # line must not grow our memory without limit
                     self.close_locked()
                     raise JSONRPCError(
-                        f"connect to {self.addr}: {exc}"
-                    ) from exc
-            self._next_id += 1
-            msg = json.dumps(
-                {"method": method, "params": [param], "id": self._next_id}
-            ).encode() + b"\n"
-            try:
-                self._sock.sendall(msg)
-                line = self._rfile.readline()
-            except (OSError, AttributeError) as exc:
-                self.close_locked()
-                raise JSONRPCError(f"rpc {method} to {self.addr}: {exc}") from exc
-            if not line:
-                self.close_locked()
-                raise JSONRPCError(f"rpc {method}: connection closed")
-            resp = json.loads(line)
-            if resp.get("error"):
-                raise JSONRPCError(str(resp["error"]))
-            return resp.get("result")
+                        f"rpc {method}: response line too large"
+                    )
+                resp = json.loads(line)
+                if resp.get("error"):
+                    raise JSONRPCError(str(resp["error"]))
+                return resp.get("result")
 
     def close_locked(self) -> None:
         if self._sock is not None:
@@ -94,7 +130,7 @@ class JSONRPCServer:
     """
 
     def __init__(self, bind_addr: str, max_line: int = DEFAULT_MAX_LINE,
-                 max_inbound: int = 64):
+                 max_inbound: int = 64, idle_timeout: float = 600.0):
         host, port = split_hostport(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -103,6 +139,11 @@ class JSONRPCServer:
         lhost, lport = self._listener.getsockname()
         self.addr = f"{lhost}:{lport}"
         self.max_line = max_line
+        # accepted sockets get a read timeout so idle (or deliberately
+        # silent) connections release their semaphore slot instead of
+        # pinning it forever; a legitimate long-idle app client simply
+        # reconnects on its next call
+        self.idle_timeout = idle_timeout
         self._conn_slots = threading.BoundedSemaphore(max_inbound)
         self._handlers: Dict[str, Callable[[Any], Any]] = {}
         self._shutdown = threading.Event()
@@ -135,13 +176,12 @@ class JSONRPCServer:
 
     def _serve_conn(self, sock: socket.socket) -> None:
         try:
+            sock.settimeout(self.idle_timeout)
             rfile = sock.makefile("rb")
             while not self._shutdown.is_set():
-                line = rfile.readline(self.max_line + 1)
-                if not line:
-                    return
-                if len(line) > self.max_line:
-                    # oversized request line: hang up before buffering more
+                line = _read_bounded_line(rfile, self.max_line)
+                if line is None:
+                    # closed, oversized, or unterminated: hang up
                     return
                 req = json.loads(line)
                 if not isinstance(req, dict) or not isinstance(

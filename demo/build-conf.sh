@@ -18,8 +18,13 @@ for i in $(seq 0 $((N - 1))); do
   mkdir -p "$DATADIR"
   PUB=$(cd "$REPO" && $PY -m babble_tpu keygen --datadir "$DATADIR" | sed -n 's/^Public Key: //p')
   PORT=$((1337 + i * 10))
+  # ADDR_PATTERN overrides the localhost scheme (e.g. 'node%I%:1337' for
+  # the docker-compose network, where each container gets a hostname)
+  PATTERN=${ADDR_PATTERN:-127.0.0.1:%PORT%}
+  ADDR=${PATTERN//%PORT%/$PORT}
+  ADDR=${ADDR//%I%/$i}
   [ "$i" -gt 0 ] && PEERS+=","
-  PEERS+="{\"NetAddr\":\"127.0.0.1:$PORT\",\"PubKeyHex\":\"$PUB\"}"
+  PEERS+="{\"NetAddr\":\"$ADDR\",\"PubKeyHex\":\"$PUB\"}"
 done
 PEERS+="]"
 
