@@ -306,6 +306,22 @@ def _step_body(
     # this deep needs rebasing (engine-level), so flag it as unreliable
     overflow = last_round >= r_cap - 1
 
+    # late-witness latch: a witness landing in an ALREADY-DECIDED round
+    # (a laggard's old events arriving long after the round settled) is a
+    # state the host engine handles by freezing that round's fame and
+    # blocking receptions behind it — semantics the dense window does not
+    # reproduce. Flag it so the caller falls back to the host engine
+    # rather than committing divergent blocks.
+    b_rounds = rounds.at[tgt].get(mode="fill", fill_value=-1)
+    b_witness = witness.at[tgt].get(mode="fill", fill_value=False)
+    rd = state.rounds_decided.at[
+        jnp.clip(b_rounds, 0, r_cap - 1)
+    ].get(mode="fill", fill_value=False)
+    late_witness = jnp.any(
+        b_witness & valid & rd & (b_rounds >= 0) & (b_rounds < r_cap)
+    )
+    overflow = overflow | late_witness
+
     return state._replace(
         la=la, fd=fd, creator=creator, index=index,
         rounds=rounds, lamport=lamport, witness=witness,
@@ -336,11 +352,18 @@ def _decide_body(
     index, creator, rounds = state.index, state.creator, state.rounds
 
     # fame over the active round window only: rounds below the first
-    # undecided one are settled forever
+    # undecided one are SETTLED FOREVER. This freeze is load-bearing for
+    # cross-node agreement, not just an optimization: the host engine
+    # (like the reference) never revisits a round once it left the
+    # pending set, so a witness landing late in an already-decided round
+    # keeps UNDEFINED fame everywhere. Re-deciding it here would leak
+    # through the round-received computation (an internally "decided"
+    # round unblocks receptions the host-engine nodes still hold back)
+    # and commit different blocks.
     r_idx = jnp.arange(r_cap)
     undecided = ~state.rounds_decided & (r_idx <= last_round)
-    floor = jnp.min(jnp.where(undecided, r_idx, last_round))
-    floor = jnp.clip(floor, 0, r_cap - r_win)
+    floor_true = jnp.min(jnp.where(undecided, r_idx, last_round))
+    floor = jnp.clip(floor_true, 0, r_cap - r_win)
 
     sl = lambda a: jax.lax.dynamic_slice(a, (floor,) + (0,) * (a.ndim - 1),
                                          (r_win,) + a.shape[1:])
@@ -348,6 +371,13 @@ def _decide_body(
         sl(wtable) >= 0, sl(la_w), sl(fd_w), sl(idx_w), sl(coin_w),
         last_round - floor, super_majority, n_participants,
     )
+    # freeze mask: when the slice start was clipped below floor_true,
+    # entries for already-settled rounds keep their stored values
+    rel = jnp.arange(r_win)
+    frozen = (floor + rel) < floor_true
+    dec_w = jnp.where(frozen[:, None], sl(state.fame_decided), dec_w)
+    fam_w = jnp.where(frozen[:, None], sl(state.famous), fam_w)
+    rdec_w = jnp.where(frozen, sl(state.rounds_decided), rdec_w)
     fame_decided = jax.lax.dynamic_update_slice(state.fame_decided, dec_w, (floor, 0))
     famous = jax.lax.dynamic_update_slice(state.famous, fam_w, (floor, 0))
     rounds_decided = jax.lax.dynamic_update_slice(state.rounds_decided, rdec_w, (floor,))
@@ -656,6 +686,16 @@ def _train_body(state: IncState, train: Train, super_majority: int,
     )
     count = state.count + jnp.sum(valid, dtype=jnp.int32)
     overflow = last_round >= r_cap - 1
+
+    # late-witness latch — see _step_body: a witness registering into an
+    # already-decided round needs the host engine's freeze semantics
+    rd = state.rounds_decided.at[
+        jnp.clip(rounds_b, 0, r_cap - 1)
+    ].get(mode="fill", fill_value=False)
+    late_witness = jnp.any(
+        witness_b & valid & rd & (rounds_b >= 0) & (rounds_b < r_cap)
+    )
+    overflow = overflow | late_witness
 
     return state._replace(
         la=la, fd=fd, creator=creator, index=index,

@@ -59,19 +59,39 @@ def derive_fd_updates(grid: DagGrid) -> List[List[Tuple[int, int, int]]]:
     return stream
 
 
-class LiveDeviceEngine:
-    """Device-resident DAG state for one live Hashgraph."""
+# constructor defaults, module-level so tests can shrink the capacities
+# to force rebases quickly
+ENGINE_DEFAULTS = dict(
+    e_cap=1 << 16, r_cap=64, batch_cap=64, upd_cap=8192, e_win=8192,
+)
 
-    def __init__(self, hg, e_cap: int = 1 << 16, r_cap: int = 64,
-                 batch_cap: int = 64, upd_cap: int = 8192, e_win: int = 8192):
+
+class LiveDeviceEngine:
+    """Device-resident DAG state for one live Hashgraph.
+
+    Capacities are finite (e_cap event rows, r_cap round slots) but the
+    DAG is not: when either axis nears exhaustion the engine REBASES —
+    it rebuilds its device state from the undecided frontier (events of
+    recent rounds + still-undetermined events), with all rounds stored
+    relative to a new ``round_base``. Decided history below the base is
+    final and never consulted again (the same windowing argument as the
+    reference's RollingIndex pruning, SURVEY §5), so a live node streams
+    indefinitely through bounded device memory."""
+
+    def __init__(self, hg, e_cap: int = None, r_cap: int = None,
+                 batch_cap: int = None, upd_cap: int = None,
+                 e_win: int = None):
+        d = ENGINE_DEFAULTS
         self.hg = hg
         self.n = len(hg.participants.to_peer_slice())
-        self.e_cap = e_cap
-        self.r_cap = r_cap
-        self.batch_cap = batch_cap
-        self.upd_cap = upd_cap
-        self.e_win = min(e_win, e_cap)
-        self.state: IncState = init_state(self.n, e_cap, r_cap)
+        self.e_cap = d["e_cap"] if e_cap is None else e_cap
+        self.r_cap = d["r_cap"] if r_cap is None else r_cap
+        self.batch_cap = d["batch_cap"] if batch_cap is None else batch_cap
+        self.upd_cap = d["upd_cap"] if upd_cap is None else upd_cap
+        self.e_win = min(d["e_win"] if e_win is None else e_win, self.e_cap)
+        self.round_base = 0
+        self.rebases = 0
+        self.state: IncState = init_state(self.n, self.e_cap, self.r_cap)
         self.row_of: Dict[str, int] = {}
         self.hashes: List[str] = []
         self.pending: List[tuple] = []  # (event, fd_writes)
@@ -121,8 +141,173 @@ class LiveDeviceEngine:
         for b in batches_from_grid(grid, self.batch_cap, self.upd_cap, self.e_cap):
             self.state = step(
                 self.state, b, self.hg.super_majority, self.n,
-                e_win=self.e_win,
+                e_win=self.e_win, r_win=min(32, self.r_cap),
             )
+
+    # -- rebasing ----------------------------------------------------------
+
+    def rebase(self) -> None:
+        """Rebuild the device state from the undecided frontier.
+
+        Kept rows: every event of an absolute round >= base, plus every
+        event whose round-received is still undetermined, where
+        base = (first fame-undecided round) - 1 — fame voting for round j
+        only ever consults round j-1's witnesses, and an event that no
+        decided round received can only be received at a round >= the
+        first undecided one, so nothing below the base can influence any
+        future decision. Rounds are stored base-relative on device;
+        run_consensus_live translates at the write-back boundary.
+
+        Everything is assembled host-side from the store (coordinates are
+        host-maintained and write-once) — one device upload, no replay.
+        """
+        import numpy as np
+
+        from ..common import StoreErr
+        from ..hashgraph.hashgraph import middle_bit
+        from ..hashgraph.round_info import Trilean
+
+        hg = self.hg
+        n, e_cap, r_cap = self.n, self.e_cap, self.r_cap
+
+        undecided = [p.index for p in hg.pending_rounds if not p.decided]
+        if undecided:
+            floor = min(undecided)
+        elif hg.last_consensus_round is not None:
+            floor = hg.last_consensus_round + 1
+        else:
+            floor = 0
+        base = max(0, floor - 1)
+        if base <= self.round_base:
+            raise GridUnsupported(
+                f"rebase cannot advance the round base (stuck at {base})"
+            )
+
+        undet = set(hg.undetermined_events)
+        kept: List[tuple] = []  # (hash, event)
+        min_undet_round = floor
+        try:
+            for h in self.hashes:
+                ev = hg.store.get_event(h)
+                if (ev.round is not None and ev.round >= base) or h in undet:
+                    kept.append((h, ev))
+                    if h in undet and ev.round is not None:
+                        min_undet_round = min(min_undet_round, ev.round)
+        except StoreErr as e:
+            raise GridUnsupported(f"rebase: frontier event evicted ({e})")
+
+        # host-frozen rounds: a round below the frontier whose witness set
+        # gained a late member has UNDEFINED fame forever on the host and
+        # blocks receptions of older events behind it. The rebased state
+        # cannot represent that block (the round is below the base), so
+        # refuse and let the host engine carry this hashgraph.
+        for r_abs in range(min_undet_round + 1, floor):
+            try:
+                if not hg.store.get_round(r_abs).witnesses_decided():
+                    raise GridUnsupported(
+                        f"rebase: round {r_abs} is host-frozen below the "
+                        f"frontier"
+                    )
+            except StoreErr:
+                continue
+        if len(kept) > e_cap - 4 * self.batch_cap:
+            raise GridUnsupported(
+                f"rebase keeps {len(kept)} rows; capacity {e_cap} too small"
+            )
+        if len(kept) > self.e_win - 2 * self.batch_cap:
+            # undetermined rows must stay inside the received fetch window
+            # (same constraint the bootstrap imposes on grid.e)
+            raise GridUnsupported(
+                f"rebase keeps {len(kept)} rows; write-back window "
+                f"{self.e_win} too small"
+            )
+
+        la = np.full((e_cap, n), -1, np.int32)
+        fd = np.full((e_cap, n), MAX_INT32, np.int32)
+        creator = np.zeros(e_cap, np.int32)
+        index = np.full(e_cap, MAX_INT32, np.int32)
+        rounds = np.full(e_cap, -1, np.int32)
+        lamport = np.full(e_cap, -1, np.int32)
+        witness = np.zeros(e_cap, bool)
+        received = np.full(e_cap, -1, np.int32)
+        w_of_row = np.full(e_cap, -1, np.int32)
+        wtable = np.full((r_cap, n), -1, np.int32)
+        la_w = np.full((r_cap, n, n), -1, np.int32)
+        fd_w = np.full((r_cap, n, n), MAX_INT32, np.int32)
+        idx_w = np.full((r_cap, n), MAX_INT32, np.int32)
+        coin_w = np.zeros((r_cap, n), bool)
+        fame_decided = np.zeros((r_cap, n), bool)
+        famous = np.zeros((r_cap, n), bool)
+        rounds_decided = np.zeros(r_cap, bool)
+
+        new_row_of: Dict[str, int] = {}
+        new_hashes: List[str] = []
+        last_abs = base
+        for k, (h, ev) in enumerate(kept):
+            new_row_of[h] = k
+            new_hashes.append(h)
+            creator[k] = hg.peer_position(ev.creator())
+            index[k] = ev.index()
+            la[k] = [c[0] for c in ev.last_ancestors]
+            fd[k] = [c[0] for c in ev.first_descendants]
+            if ev.round is not None:
+                rounds[k] = ev.round - base
+                last_abs = max(last_abs, ev.round)
+            lamport[k] = (
+                ev.lamport_timestamp if ev.lamport_timestamp is not None else -1
+            )
+            rr = ev.round_received
+            received[k] = (rr - base) if (rr is not None and h not in undet) else -1
+
+        # witness tables + fame state for the kept round window
+        for r_abs in range(base, min(last_abs, base + r_cap - 1) + 1):
+            sh = r_abs - base
+            try:
+                ri = hg.store.get_round(r_abs)
+            except StoreErr:
+                continue
+            for h, re in ri.events.items():
+                if not re.witness:
+                    continue
+                row = new_row_of.get(h)
+                if row is None:
+                    raise GridUnsupported(
+                        f"rebase: witness of round {r_abs} not kept"
+                    )
+                c = int(creator[row])
+                wtable[sh, c] = row
+                la_w[sh, c] = la[row]
+                fd_w[sh, c] = fd[row]
+                idx_w[sh, c] = index[row]
+                coin_w[sh, c] = middle_bit(h)
+                w_of_row[row] = sh * n + c
+                if re.famous != Trilean.UNDEFINED:
+                    fame_decided[sh, c] = True
+                    famous[sh, c] = re.famous == Trilean.TRUE
+            rounds_decided[sh] = ri.witnesses_decided()
+
+        import jax
+        import jax.numpy as jnp
+
+        self.state = IncState(
+            la=jax.device_put(la), fd=jax.device_put(fd),
+            creator=jax.device_put(creator), index=jax.device_put(index),
+            rounds=jax.device_put(rounds), lamport=jax.device_put(lamport),
+            witness=jax.device_put(witness), received=jax.device_put(received),
+            w_of_row=jax.device_put(w_of_row), wtable=jax.device_put(wtable),
+            la_w=jax.device_put(la_w), fd_w=jax.device_put(fd_w),
+            idx_w=jax.device_put(idx_w), coin_w=jax.device_put(coin_w),
+            fame_decided=jax.device_put(fame_decided),
+            famous=jax.device_put(famous),
+            rounds_decided=jax.device_put(rounds_decided),
+            last_round=jnp.int32(last_abs - base),
+            count=jnp.int32(len(kept)),
+            stale=jnp.bool_(False), fame_lag=jnp.bool_(False),
+        )
+        self.row_of = new_row_of
+        self.hashes = new_hashes
+        self.round_base = base
+        self.rebases += 1
 
     # -- advancing ---------------------------------------------------------
 
@@ -160,7 +345,7 @@ class LiveDeviceEngine:
             for b in built:
                 self.state = step(
                     self.state, b, self.hg.super_majority, self.n,
-                    e_win=self.e_win,
+                    e_win=self.e_win, r_win=min(32, self.r_cap),
                 )
         else:
             for i in range(0, len(built), 16):
@@ -169,7 +354,7 @@ class LiveDeviceEngine:
                 group = group + [self._empty_batch()] * (k - len(group))
                 self.state = multi_step(
                     self.state, stack_batches(group),
-                    self.hg.super_majority, self.n, e_win=self.e_win,
+                    self.hg.super_majority, self.n, e_win=self.e_win, r_win=min(32, self.r_cap),
                 )
         return new_rows
 
@@ -238,13 +423,19 @@ class LiveDeviceEngine:
             sp = self.row_of.get(ev.self_parent(), -1)
             op = self.row_of.get(ev.other_parent(), -1)
             if sp < 0 and ev.index() != 0:
+                # a rebased engine dropped decided history: a creator
+                # reviving after rounds of silence has a pruned self-parent
                 raise GridUnsupported("self-parent outside device state")
             if op < 0 and ev.other_parent() != "":
                 raise GridUnsupported("other-parent outside device state")
             if sp < 0 and ev.other_parent() == "":
                 # directly root-attached: round forced to the base root's
                 # next_round (reference: hashgraph.go:207-236); first
-                # events WITH an other-parent compute theirs normally
+                # events WITH an other-parent compute theirs normally.
+                # Rounds are base-relative on device; genesis attachment
+                # can only occur before any rebase (base 0).
+                if self.round_base > 0:
+                    raise GridUnsupported("root attachment after rebase")
                 fixed_round[k] = 0
             sp_row[k] = sp
             op_row[k] = op
@@ -253,7 +444,11 @@ class LiveDeviceEngine:
             for ah, pos, val in fd_writes:
                 arow = self.row_of.get(ah)
                 if arow is None:
-                    raise GridUnsupported("fd update target outside device state")
+                    # pruned-by-rebase ancestor: its fd row is final and
+                    # can never be read again — drop the update. (fd
+                    # writes come from the hashgraph's own insert walk,
+                    # so the hash is always a real ancestor.)
+                    continue
                 upd.append((arow, pos, val))
 
         if len(upd) > self.upd_cap:
@@ -315,7 +510,8 @@ def _pack_results(st: IncState, lo, e_win: int, r_cap: int, n: int):
         st.wtable.reshape(-1),
         st.fame_decided.astype(jnp.int32).reshape(-1),
         st.famous.astype(jnp.int32).reshape(-1),
-        jnp.stack([st.stale.astype(jnp.int32), st.fame_lag.astype(jnp.int32)]),
+        jnp.stack([st.stale.astype(jnp.int32), st.fame_lag.astype(jnp.int32),
+                   st.last_round]),
     ])
 
 
@@ -333,9 +529,10 @@ def _unpack_results(packed, e_win: int, r_cap: int, n: int):
     wtable = take(r_cap * n, (r_cap, n))
     fame_decided = take(r_cap * n, (r_cap, n)).astype(bool)
     famous = take(r_cap * n, (r_cap, n)).astype(bool)
-    flags = take(2)
+    flags = take(3)
     return (rounds_w, lamport_w, witness_w, received_w, wtable,
-            fame_decided, famous, bool(flags[0]), bool(flags[1]))
+            fame_decided, famous, bool(flags[0]), bool(flags[1]),
+            int(flags[2]))
 
 
 def run_consensus_live(hg) -> None:
@@ -367,7 +564,9 @@ def run_consensus_live(hg) -> None:
         _pack_results(st, jnp_int32(lo), eng.e_win, eng.r_cap, eng.n)
     )
     (rounds_w, lamport_w, witness_w, received_w, wtable, fame_decided,
-     famous, stale, fame_lag) = _unpack_results(packed, eng.e_win, eng.r_cap, eng.n)
+     famous, stale, fame_lag, last_round_rel) = _unpack_results(
+        packed, eng.e_win, eng.r_cap, eng.n)
+    base = eng.round_base
     rounds_w = rounds_w[: count - lo]
     lamport_w = lamport_w[: count - lo]
     witness_w = witness_w[: count - lo]
@@ -390,7 +589,7 @@ def run_consensus_live(hg) -> None:
     for row in new_rows:
         h = eng.hashes[row]
         ev = hg.store.get_event(h)
-        rnum = int(at(row, rounds_w))
+        rnum = int(at(row, rounds_w)) + base
         ev.set_round(rnum)
         ev.set_lamport_timestamp(int(at(row, lamport_w)))
         hg.store.set_event(ev)
@@ -419,13 +618,14 @@ def run_consensus_live(hg) -> None:
         if ri is None:
             ri = hg.store.get_round(pr.index)
             round_infos[pr.index] = ri
-        if pr.index < eng.r_cap:
+        sh = pr.index - base
+        if 0 <= sh < eng.r_cap:
             for c in range(eng.n):
-                wrow = int(wtable[pr.index, c])
+                wrow = int(wtable[sh, c])
                 if wrow < 0:
                     continue
-                if fame_decided[pr.index, c]:
-                    ri.set_fame(eng.hashes[wrow], bool(famous[pr.index, c]))
+                if fame_decided[sh, c]:
+                    ri.set_fame(eng.hashes[wrow], bool(famous[sh, c]))
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
     for pr in hg.pending_rounds:
@@ -438,6 +638,7 @@ def run_consensus_live(hg) -> None:
         row = eng.row_of[h]
         rr = int(at(row, received_w))
         if rr >= 0:
+            rr += base
             ev = hg.store.get_event(h)
             ev.set_round_received(rr)
             hg.store.set_event(ev)
@@ -456,3 +657,25 @@ def run_consensus_live(hg) -> None:
     # --- host passes 4-5 --------------------------------------------------
     hg.process_decided_rounds()
     hg.process_sig_pool()
+
+    # --- capacity management ----------------------------------------------
+    # rebase BEFORE either device axis exhausts: the round axis needs
+    # headroom for fame-decision lag (~8 rounds), the event axis for the
+    # next few syncs' appends. A momentarily-stuck rebase (fame decisions
+    # lagging, so the base cannot advance yet) is tolerated while hard
+    # room remains — it is retried on every subsequent sync; only an
+    # exhausted axis escalates to the caller's fallback.
+    soft = (
+        last_round_rel >= eng.r_cap - 8
+        or len(eng.hashes) >= eng.e_cap - 4 * eng.batch_cap
+    )
+    hard = (
+        last_round_rel >= eng.r_cap - 3
+        or len(eng.hashes) >= eng.e_cap - eng.batch_cap
+    )
+    if soft:
+        try:
+            eng.rebase()
+        except GridUnsupported:
+            if hard:
+                raise

@@ -109,6 +109,47 @@ def test_mixed_backend_cluster_byte_identical():
         shutdown_nodes(nodes)
 
 
+def test_device_backend_rebases_past_round_capacity(monkeypatch):
+    """A live device engine with a tiny round axis must REBASE through it
+    (round_base advances, not a CPU fallback) while the mixed cluster's
+    blocks stay byte-identical — the streaming/windowing axis of
+    SURVEY §5 and BASELINE config #5 at live-node scale."""
+    from babble_tpu.tpu import live as live_mod
+
+    monkeypatch.setitem(live_mod.ENGINE_DEFAULTS, "r_cap", 16)
+    monkeypatch.setitem(live_mod.ENGINE_DEFAULTS, "e_cap", 4096)
+    monkeypatch.setitem(live_mod.ENGINE_DEFAULTS, "e_win", 4096)
+
+    # sync_limit large enough that ordinary virtual-device dispatch lag
+    # doesn't flip nodes into CatchingUp, but finite so a genuinely
+    # stuck node can still escape via fast-sync instead of deadlocking
+    # against the others' rolled windows
+    nodes, proxies, *_ = build_mixed_cluster(
+        ["cpu", "tpu", "tpu", "tpu"], sync_limit=2000
+    )
+    try:
+        run_nodes(nodes)
+        # past the 16-round device axis: forces rebases (the trigger
+        # fires at shifted round r_cap - 8 = 8). Kept modest: on the
+        # virtual CPU device every sync pays a real dispatch, and too
+        # ambitious a target can starve the slowest node of gossip.
+        bombard_and_wait(nodes, proxies, target_block=15, timeout_s=300)
+        # byte-equality across backends is unconditional
+        check_gossip(nodes, upto=15)
+        # under adversarial timing an engine may legitimately retire
+        # through its safety valves (fast-sync reset, late-witness latch,
+        # host-frozen round) — but the rebase mechanism itself must have
+        # carried at least one node through multiple round-axis windows
+        rebased = [
+            eng for node in nodes[1:]
+            if (eng := getattr(node.core.hg, "_live_device_engine", None))
+            is not None and eng.rebases >= 1 and eng.round_base > 0
+        ]
+        assert rebased, "no device node survived past r_cap via rebase"
+    finally:
+        shutdown_nodes(nodes)
+
+
 def test_device_backend_survives_fast_sync():
     """A device-backend node killed and recycled must fast-forward (Reset +
     section replay) and KEEP running the device engine on the post-reset
