@@ -117,15 +117,19 @@ def build_inv(rows_by: jax.Array, la: jax.Array) -> jax.Array:
     engine maintains it incrementally alongside la/fd (appending an event
     updates one chain's slice), so precomputing it outside the timed
     pipeline mirrors production use."""
-    n, l = rows_by.shape
+    # the chain axis and the coordinate axis are sized independently: under
+    # shard_map (sharded.py) rows_by holds only this device's chain block
+    # while la keeps the full N-wide coordinate vectors
+    n_c, l = rows_by.shape
+    n_p = la.shape[1]
     pad = rows_by < 0
     rb = jnp.maximum(rows_by, 0)
-    la_chain = jnp.where(pad[:, :, None], -1, la[rb])  # (N, L, N)
-    c_idx = jnp.broadcast_to(jnp.arange(n)[:, None, None], (n, l, n))
-    i_idx = jnp.broadcast_to(jnp.arange(l)[None, :, None], (n, l, n))
-    p_idx = jnp.broadcast_to(jnp.arange(n)[None, None, :], (n, l, n))
+    la_chain = jnp.where(pad[:, :, None], -1, la[rb])  # (N_c, L, N_p)
+    c_idx = jnp.broadcast_to(jnp.arange(n_c)[:, None, None], (n_c, l, n_p))
+    i_idx = jnp.broadcast_to(jnp.arange(l)[None, :, None], (n_c, l, n_p))
+    p_idx = jnp.broadcast_to(jnp.arange(n_p)[None, None, :], (n_c, l, n_p))
     v_slot = jnp.where(la_chain >= 0, jnp.minimum(la_chain, l - 1), l)
-    inv0 = jnp.full((n, n, l + 1), l, jnp.int32)
+    inv0 = jnp.full((n_c, n_p, l + 1), l, jnp.int32)
     inv0 = inv0.at[c_idx, p_idx, v_slot].min(i_idx)
     inv = suffix_min(inv0[:, :, :l], l, axis=2)
     return inv.astype(jnp.float32)
@@ -188,6 +192,18 @@ def _frontier_rounds(
         return x_next, x_cur
 
     _, x_hist = jax.lax.scan(step, x0, None, length=r_cap)  # (r_cap, N)
+    return frontier_post(x_hist, rows_by, creator, index, sp_index)
+
+
+def frontier_post(x_hist, rows_by, creator, index, sp_index) -> FrontierResult:
+    """Witness table + per-event rounds from the frontier history — shared
+    verbatim by the single-device walk and the chains-sharded walk
+    (sharded.py), so their outputs agree bit-for-bit by construction."""
+    n, l = rows_by.shape
+    r_cap = x_hist.shape[0]
+    sent = jnp.int32(l)
+    rb = jnp.maximum(rows_by, 0)
+    cc = jnp.arange(n)
     x_next_hist = jnp.concatenate(
         [x_hist[1:], jnp.full((1, n), l, jnp.int32)], axis=0
     )

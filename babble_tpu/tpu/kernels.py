@@ -46,7 +46,12 @@ import jax.numpy as jnp
 
 MAX_INT32 = 2**31 - 1
 MIN_INT32 = -(2**31)
-NEG = jnp.int32(-1)
+
+# NOTE: no module-level jnp array constants here. Creating one initializes
+# the process's *default* JAX backend (the real TPU under the tunnel) as a
+# side effect of `import kernels`, which breaks CPU-pinned host processes
+# (e.g. the driver's multichip dryrun). tests/test_multichip.py pins this
+# with an import-purity subprocess test.
 
 
 def suffix_min(x: jax.Array, fill, axis: int = -1) -> jax.Array:

@@ -35,7 +35,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import kernels
 from .engine import PassResults
-from .grid import DagGrid
+from .frontier import frontier_post
+from .grid import DagGrid, MAX_INT32
+
+# module-level jit so repeated pipeline runs reuse the compiled post-walk
+_frontier_post_jit = jax.jit(frontier_post)
 
 
 def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
@@ -162,9 +166,13 @@ def _fame_tables(wtable, la, decided, famous, last_round):
     return min_la, famous_count, i_ok, horizon, rounds_decided
 
 
-def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults:
-    """Full three-pass pipeline over a device mesh; results identical to
-    the single-device `engine.run_passes` (differential-tested)."""
+def _sharded_fame_received(
+    mesh, grid: DagGrid, wtable_np, la, fd, index, rounds_np, last_round,
+    chunk: int,
+):
+    """Passes 2+3 over the mesh, shared by the level-scan and frontier
+    entry points: rounds-sharded fame voting with ring-shifted voters,
+    then events-sharded round-received. Returns host numpy results."""
     axis = mesh.axis_names[0]
     ndev = int(np.prod(mesh.devices.shape))
     rep = NamedSharding(mesh, P())
@@ -172,31 +180,12 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
     shard_r2 = NamedSharding(mesh, P(axis, None))
     shard_r3 = NamedSharding(mesh, P(axis, None, None))
 
-    r_max = grid.r_max
-    r_pad = ((r_max + ndev - 1) // ndev) * ndev
+    r_rows = wtable_np.shape[0]
+    r_pad = ((r_rows + ndev - 1) // ndev) * ndev
     e_pad = ((max(grid.e, 1) + ndev - 1) // ndev) * ndev
 
-    # ---- pass 1: DivideRounds, replicated over the mesh ----
-    # device_put straight from numpy: never touches the default backend, so
-    # the pipeline runs entirely on the mesh's devices (the dryrun relies on
-    # this to stay off the real TPU)
     putr = lambda x: jax.device_put(np.asarray(x), rep)
-    la = putr(grid.last_ancestors)
-    fd = putr(grid.first_descendants)
-    index = putr(grid.index)
-    dr = kernels.divide_rounds(
-        putr(grid.levels), putr(grid.creator), index,
-        putr(grid.self_parent), putr(grid.other_parent), la, fd,
-        putr(grid.ext_sp_round), putr(grid.ext_op_round),
-        putr(grid.fixed_round), putr(grid.ext_sp_lamport),
-        putr(grid.ext_op_lamport), putr(grid.fixed_lamport),
-        grid.super_majority, r_max,
-    )
-    last_round = jnp.max(dr.rounds)
-
-    # ---- pass 2: DecideFame, rounds-sharded with ring-shifted voters ----
-    wtable_np = _pad_axis0(np.asarray(dr.witness_table), r_pad, -1)
-    wtable = putr(wtable_np)
+    wtable = putr(_pad_axis0(wtable_np, r_pad, -1))
     ss, votes0, wvalid, coin_w = kernels._fame_setup(
         wtable, la, fd, index, putr(grid.coin_bit), grid.super_majority
     )
@@ -221,7 +210,6 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
         if not bool(active) or d0 > r_pad + 2:
             break
 
-    # ---- pass 3: DecideRoundReceived, events-sharded ----
     min_la, famous_count, i_ok, horizon, rounds_decided = _fame_tables(
         wtable, la, decided, famous, last_round
     )
@@ -230,19 +218,255 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
     )
     received = _received_fn(mesh, axis)(
         pute(grid.index, 0), pute(grid.creator, 0),
-        pute(np.asarray(dr.rounds), -1),
+        pute(rounds_np, -1),
         jax.device_put(min_la, rep), jax.device_put(famous_count, rep),
         jax.device_put(i_ok, rep), jax.device_put(horizon, rep),
     )
+    return (
+        np.asarray(decided)[:r_rows],
+        np.asarray(famous)[:r_rows],
+        np.asarray(rounds_decided)[:r_rows],
+        np.asarray(received)[: grid.e],
+    )
+
+
+def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults:
+    """Full three-pass pipeline over a device mesh; results identical to
+    the single-device `engine.run_passes` (differential-tested)."""
+    rep = NamedSharding(mesh, P())
+    r_max = grid.r_max
+
+    # ---- pass 1: DivideRounds, replicated over the mesh ----
+    # device_put straight from numpy: never touches the default backend, so
+    # the pipeline runs entirely on the mesh's devices (the dryrun relies on
+    # this to stay off the real TPU)
+    putr = lambda x: jax.device_put(np.asarray(x), rep)
+    la = putr(grid.last_ancestors)
+    fd = putr(grid.first_descendants)
+    index = putr(grid.index)
+    dr = kernels.divide_rounds(
+        putr(grid.levels), putr(grid.creator), index,
+        putr(grid.self_parent), putr(grid.other_parent), la, fd,
+        putr(grid.ext_sp_round), putr(grid.ext_op_round),
+        putr(grid.fixed_round), putr(grid.ext_sp_lamport),
+        putr(grid.ext_op_lamport), putr(grid.fixed_lamport),
+        grid.super_majority, r_max,
+    )
+    last_round = jnp.max(dr.rounds)
+
+    # ---- passes 2+3: fame (rounds-sharded) + received (events-sharded) ----
+    rounds_np = np.asarray(dr.rounds)
+    decided, famous, rounds_decided, received = _sharded_fame_received(
+        mesh, grid, np.asarray(dr.witness_table), la, fd, index,
+        rounds_np, last_round, chunk,
+    )
 
     return PassResults(
-        rounds=np.asarray(dr.rounds),
+        rounds=rounds_np,
         witness=np.asarray(dr.witness),
         lamport=np.asarray(dr.lamport),
         witness_table=np.asarray(dr.witness_table),
-        fame_decided=np.asarray(decided)[:r_max],
-        famous=np.asarray(famous)[:r_max],
-        rounds_decided=np.asarray(rounds_decided)[:r_max],
-        received=np.asarray(received)[: grid.e],
+        fame_decided=decided,
+        famous=famous,
+        rounds_decided=rounds_decided,
+        received=received,
+        last_round=int(last_round),
+    )
+
+
+# ---------------------------------------------------------------------------
+# chains-sharded round-frontier pipeline (the flagship kernel, multi-chip)
+# ---------------------------------------------------------------------------
+#
+# The frontier walk's big tensor is INV: (N, N, L) f32 — the per-chain
+# threshold tables (frontier.py:build_inv). It is partitioned over axis 0
+# (the owning chain), so each device holds and contracts only its N/ndev
+# chains' tables; the frontier state X(r) is an (N,) vector kept globally
+# consistent by two tiny all-gathers per round step (the per-chain
+# strongly-see thresholds m0 and the closed frontier x_next). Witness-table
+# assembly and per-event rounds reuse frontier.frontier_post verbatim, and
+# fame/received ride the existing rounds-/events-sharded stages — so the
+# whole flagship pipeline is mesh-partitioned end to end.
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_build_inv_fn(mesh: Mesh, axis: str):
+    """shard_mapped build_inv: each device builds the INV slices of its
+    own chains (pure local compute, no collectives)."""
+    from .frontier import build_inv
+
+    return jax.jit(
+        jax.shard_map(
+            build_inv,
+            mesh=mesh,
+            in_specs=(P(axis, None), P()),
+            out_specs=P(axis, None, None),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
+                      l: int):
+    """shard_mapped frontier walk: INV and the chain table sharded over
+    chains; fd replicated; the whole r_cap-step scan runs in ONE dispatch
+    with two (N/ndev,)-sized all-gathers per step riding ICI."""
+
+    def local_walk(inv_local, rb_local, fd, x0_local):
+        # (B, N_p, L), (B, L), (E, N_p) replicated, (B,)
+        b = rb_local.shape[0]
+        sent = jnp.int32(l)
+        rb = jnp.maximum(rb_local, 0)
+        vv = jnp.arange(l)
+        bb = jnp.arange(b)
+
+        def step(x_local, _):
+            # my chains' frontier rows -> their fd coordinate vectors
+            w_row = rb[bb, jnp.clip(x_local, 0, l - 1)]  # (B,)
+            w_ok = x_local < sent
+            fd_w_local = jnp.where(w_ok[:, None], fd[w_row], MAX_INT32)
+
+            # every device needs every frontier row's coordinates to test
+            # its own chains against: gather the small (N, N_p) int table
+            fd_w = jax.lax.all_gather(fd_w_local, axis, tiled=True)
+
+            # u[w, c_local, p] = first local-chain-c index whose
+            # p-coordinate reaches fd_w[w, p] — one-hot MXU contraction
+            # against the LOCAL INV shard only (1/ndev of the FLOPs)
+            oh = (
+                jnp.clip(fd_w, 0, l - 1)[:, :, None] == vv[None, None, :]
+            ).astype(jnp.float32)  # (N_w, N_p, L)
+            u = jnp.einsum(
+                "wpv,cpv->wcp", oh, inv_local,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
+            u = jnp.where((fd_w < MAX_INT32)[:, None, :], u, sent)
+
+            # t[w, c_local] = first local-chain index strongly seeing
+            # frontier row w; m0 = supermajority-th smallest over w
+            t = jnp.sort(u, axis=2)[:, :, super_majority - 1]
+            m0_local = jnp.sort(t, axis=0)[super_majority - 1, :]  # (B,)
+            m0 = jax.lax.all_gather(m0_local, axis, tiled=True)  # (N,)
+
+            # cross-chain closure, one pass (coordinate transitivity) —
+            # the x axis is chains-as-coordinates, so slice the gathered m0
+            # back to the real coordinate width (chain padding has no
+            # coordinate column)
+            n_p = fd.shape[1]
+            oh2 = (
+                jnp.clip(m0[:n_p], 0, l - 1)[:, None] == vv[None, :]
+            ).astype(jnp.float32)  # (N_x, L)
+            reach = jnp.einsum(
+                "xv,cxv->cx", oh2, inv_local,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)  # (B, N_x)
+            reach = jnp.where((m0[:n_p] < sent)[None, :], reach, sent)
+            x_next = jnp.minimum(m0_local, jnp.min(reach, axis=1))
+            x_next = jnp.minimum(jnp.maximum(x_next, x_local), sent)
+            return x_next, x_local
+
+        _, x_hist_local = jax.lax.scan(step, x0_local, None, length=r_cap)
+        return x_hist_local  # (r_cap, B)
+
+    return jax.jit(
+        jax.shard_map(
+            local_walk,
+            mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None), P(), P(axis)),
+            out_specs=P(None, axis),
+        )
+    )
+
+
+def sharded_frontier_passes(
+    mesh: Mesh, grid: DagGrid, chunk: int = 8, r_cap: int = None
+) -> PassResults:
+    """The round-frontier pipeline over a device mesh: INV/chain tables
+    sharded over chains, fame rounds-sharded, received events-sharded.
+    Results identical to the single-device engine.run_frontier_passes
+    (differential-tested in tests/test_multichip.py). Requires a
+    frontier-safe (base-state) grid — see engine._frontier_safe."""
+    from .engine import pad_grid, _bucket
+    from .frontier import chain_table, level_lamport, sp_index_of
+
+    axis = mesh.axis_names[0]
+    ndev = int(np.prod(mesh.devices.shape))
+    rep = NamedSharding(mesh, P())
+
+    e_real = grid.e
+    rows_by = chain_table(grid)
+    sp_index = sp_index_of(grid)
+    lamport = level_lamport(grid)
+    grid_p = pad_grid(grid)
+    pad_e = grid_p.creator.shape[0] - e_real
+    # same E-padding semantics as engine.run_frontier_passes: index -1
+    # keeps padded rows below every frontier value
+    index_np = np.concatenate([grid.index, np.full(pad_e, -1, np.int32)])
+    sp_index = np.concatenate([sp_index, np.full(pad_e, -1, np.int32)])
+    lamport = np.concatenate([lamport, np.full(pad_e, -1, np.int32)])
+
+    l_b = _bucket(rows_by.shape[1], 64, factor=2)
+    n_pad = ((grid.n + ndev - 1) // ndev) * ndev
+    rb_pad = np.full((n_pad, l_b), -1, dtype=np.int32)
+    rb_pad[: grid.n, : rows_by.shape[1]] = rows_by
+    # l_b + 2 is the provable cap: a round advance moves every chain's
+    # frontier index by >= 1, so last_round < L <= l_b (same bound as
+    # engine._adaptive_r_loop's cap_bound)
+    r_hard = l_b + 2
+    if r_cap is None:
+        r_cap = r_hard
+
+    shard_c = NamedSharding(mesh, P(axis, None))
+    putr = lambda x: jax.device_put(np.asarray(x), rep)
+    la = putr(grid_p.last_ancestors)
+    fd = putr(grid_p.first_descendants)
+    index = putr(index_np)
+    rb_dev = jax.device_put(rb_pad, shard_c)
+
+    # ---- pass 1a: INV construction, chains-sharded ----
+    inv = _sharded_build_inv_fn(mesh, axis)(rb_dev, la)
+
+    # ---- pass 1b: frontier walk, chains-sharded ----
+    x0 = jax.device_put(
+        np.where(rb_pad[:, 0] >= 0, 0, l_b).astype(np.int32),
+        NamedSharding(mesh, P(axis)),
+    )
+    while True:
+        x_hist = _frontier_walk_fn(mesh, axis, grid.super_majority, r_cap, l_b)(
+            inv, rb_dev, fd, x0
+        )
+
+        # ---- pass 1c: witness table + per-event rounds (shared post-walk) --
+        fr = _frontier_post_jit(
+            jax.device_put(x_hist, rep), rb_dev, putr(grid_p.creator), index,
+            putr(sp_index),
+        )
+        last_round = fr.last_round
+        # an undersized caller-supplied r_cap truncates the walk and would
+        # silently mis-round every event past it — detect via the same
+        # last_round margin as the single-device adaptive loop and re-run
+        # at the provable cap
+        if int(last_round) + 2 <= r_cap or r_cap >= r_hard:
+            break
+        r_cap = r_hard
+    wtable_np = np.asarray(fr.witness_table)[:, : grid.n]
+
+    # ---- passes 2+3: fame (rounds-sharded) + received (events-sharded) ----
+    # rounds from the padded walk are sliced back to real events; the
+    # shared stage re-pads to its own mesh-divisible event bucket
+    rounds_np = np.asarray(fr.rounds)[:e_real]
+    decided, famous, rounds_decided, received = _sharded_fame_received(
+        mesh, grid, wtable_np, la, fd, index, rounds_np, last_round, chunk,
+    )
+
+    return PassResults(
+        rounds=rounds_np,
+        witness=np.asarray(fr.witness)[:e_real],
+        lamport=lamport[:e_real],
+        witness_table=wtable_np,
+        fame_decided=decided,
+        famous=famous,
+        rounds_decided=rounds_decided,
+        received=received,
         last_round=int(last_round),
     )
