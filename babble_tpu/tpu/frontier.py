@@ -142,41 +142,124 @@ class FrontierResult(NamedTuple):
     last_round: jax.Array  # () int32
 
 
-def _frontier_rounds(
-    inv_f32, rows_by, creator, index, sp_index, fd, super_majority: int,
-    r_cap: int,
-) -> FrontierResult:
+# chain-count threshold above which the m0 stage switches from the
+# einsum+sort form (materializes a (N, N, N) tensor — 4.3 GB at N=1024)
+# to the binary-search form (N^2-sized intermediates only)
+M0_BINSEARCH_MIN_N = 512
+
+
+def _m0_einsum_sort(fd_w, w_ok, inv_f32, super_majority: int, l: int):
+    """m0 via INV lookups: u[w, c, p] = first chain-c index whose
+    p-coordinate reaches fd_w[w, p] as a one-hot MXU contraction, then the
+    supermajority-th smallest along p and along w. Materializes (N, N, N):
+    the right form while N^3 stays cache-sized (the N=64 flagship config),
+    catastrophic at N=1024."""
+    sent = jnp.int32(l)
+    vv = jnp.arange(l)
+    oh = (
+        jnp.clip(fd_w, 0, l - 1)[:, :, None] == vv[None, None, :]
+    ).astype(jnp.float32)  # (w, p, v)
+    u = jnp.einsum(
+        "wpv,cpv->wcp", oh, inv_f32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+    u = jnp.where((fd_w < MAX_INT32)[:, None, :], u, sent)
+    u = jnp.where(w_ok[:, None, None], u, sent)
+
+    # t[w, c] = first chain-c index strongly seeing frontier row w;
+    # m0[c] = first chain-c index strongly seeing a supermajority
+    t = jnp.sort(u, axis=2)[:, :, super_majority - 1]
+    return jnp.sort(t, axis=0)[super_majority - 1, :]  # (N_c,)
+
+
+def _m0_binsearch(fd_w, w_ok, rb, chain_len, la, super_majority: int, l: int):
+    """m0 via per-chain binary search over the chain index.
+
+    "Event i of chain c strongly sees >= supermajority of the frontier
+    rows" is monotone in i (lastAncestors are non-decreasing along a
+    chain), so the first such index is found in ~log2(l) probes; each
+    probe evaluates ONE event per chain against every frontier row — an
+    (N_c, N_w, N_p) compare-reduce XLA fuses without materializing
+    anything N^3-sized. Probes beyond the chain end are clamped to the
+    last event (same predicate value), which keeps the search monotone;
+    chains whose last event does not qualify resolve to the sentinel."""
+    n = rb.shape[0]
+    sent = jnp.int32(l)
+    cc = jnp.arange(n)
+    last = jnp.maximum(chain_len - 1, 0)
+
+    lo = jnp.zeros((n,), jnp.int32)
+    hi = jnp.full((n,), l, jnp.int32)
+    steps = max(1, (l - 1).bit_length()) + 1
+    for _ in range(steps):
+        mid = jnp.minimum((lo + hi) // 2, l - 1)
+        probe = jnp.minimum(mid, last)
+        ev = rb[cc, probe]  # (N_c,) rows of the probed events
+        la_mid = la[ev]  # (N_c, N_p)
+        cnt_p = jnp.sum(
+            la_mid[:, None, :] >= fd_w[None, :, :], axis=-1, dtype=jnp.int32
+        )  # (N_c, N_w)
+        sees = (cnt_p >= super_majority) & w_ok[None, :]
+        pred = (
+            (jnp.sum(sees, axis=1, dtype=jnp.int32) >= super_majority)
+            & (chain_len > 0)
+        )
+        hi = jnp.where(pred, jnp.minimum(mid, hi), hi)
+        lo = jnp.where(pred, lo, mid + 1)
+    # hi is the first qualifying (clamped) probe; beyond-end probes only
+    # repeat the last event's verdict, so a real result is always < len
+    return jnp.where(hi < chain_len, hi, sent)
+
+
+def make_walk_step(inv_f32, rows_by, fd, la, super_majority: int,
+                   m0_mode: str = "auto"):
+    """Build the one-round frontier transition X(r) -> X(r+1) over the
+    given tables. Shared by the full walk (_frontier_rounds) and the
+    warm-start windowed walk of the live engine (frontier_live.py).
+    m0_mode: "auto" picks by N (M0_BINSEARCH_MIN_N), or force
+    "binsearch"/"sort".
+
+    fd may be None: first-descendant rows are then derived from INV via
+    the identity fd[e, p] == INV[p, creator(e), index(e)] (the first
+    chain-p index whose creator(e)-coordinate reaches index(e) IS e's
+    first descendant on chain p) — the frontier-live engine maintains only
+    INV and never materializes an fd matrix."""
     n, l = rows_by.shape
     sent = jnp.int32(l)
     rb = jnp.maximum(rows_by, 0)
     cc = jnp.arange(n)
     vv = jnp.arange(l)
+    use_binsearch = (
+        m0_mode == "binsearch"
+        or (m0_mode == "auto" and n >= M0_BINSEARCH_MIN_N and la is not None)
+    )
+    chain_len = jnp.sum(rows_by >= 0, axis=1).astype(jnp.int32)
 
-    # base grids: every non-empty chain's first event is root-attached
-    # with round 0
-    x0 = jnp.where(rows_by[:, 0] >= 0, 0, sent)
-
-    def step(x_cur, _):
-        w_row = rb[cc, jnp.clip(x_cur, 0, l - 1)]  # (N,)
+    def step(x_cur):
         w_ok = x_cur < sent
-        fd_w = jnp.where(w_ok[:, None], fd[w_row], MAX_INT32)  # (N_w, N_p)
+        if fd is None:
+            # fd_w[c, p] = INV[p, c, x_cur[c]] — one-hot contraction over
+            # the value axis; INV's sentinel l maps to "no descendant"
+            oh_x = (
+                jnp.clip(x_cur, 0, l - 1)[:, None] == vv[None, :]
+            ).astype(jnp.float32)  # (C, V)
+            fdw = jnp.einsum(
+                "cv,pcv->cp", oh_x, inv_f32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
+            fd_w = jnp.where(
+                w_ok[:, None] & (fdw < sent), fdw, MAX_INT32
+            )  # (N_w, N_p)
+        else:
+            w_row = rb[cc, jnp.clip(x_cur, 0, l - 1)]  # (N,)
+            fd_w = jnp.where(w_ok[:, None], fd[w_row], MAX_INT32)  # (N_w, N_p)
 
-        # u[w, c, p] = first chain-c index whose p-coordinate reaches
-        # fd_w[w, p] — INV lookup as a one-hot MXU contraction
-        oh = (
-            jnp.clip(fd_w, 0, l - 1)[:, :, None] == vv[None, None, :]
-        ).astype(jnp.float32)  # (w, p, v)
-        u = jnp.einsum(
-            "wpv,cpv->wcp", oh, inv_f32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).astype(jnp.int32)
-        u = jnp.where((fd_w < MAX_INT32)[:, None, :], u, sent)
-        u = jnp.where(w_ok[:, None, None], u, sent)
-
-        # t[w, c] = first chain-c index strongly seeing frontier row w;
-        # m0[c] = first chain-c index strongly seeing a supermajority
-        t = jnp.sort(u, axis=2)[:, :, super_majority - 1]
-        m0 = jnp.sort(t, axis=0)[super_majority - 1, :]  # (N_c,)
+        if use_binsearch:
+            m0 = _m0_binsearch(
+                fd_w, w_ok, rb, chain_len, la, super_majority, l
+            )
+        else:
+            m0 = _m0_einsum_sort(fd_w, w_ok, inv_f32, super_majority, l)
 
         # cross-chain closure, one pass (coordinate transitivity)
         oh2 = (
@@ -189,9 +272,30 @@ def _frontier_rounds(
         reach = jnp.where((m0 < sent)[None, :], reach, sent)
         x_next = jnp.minimum(m0, jnp.min(reach, axis=1))
         x_next = jnp.minimum(jnp.maximum(x_next, x_cur), sent)
-        return x_next, x_cur
+        return x_next
 
-    _, x_hist = jax.lax.scan(step, x0, None, length=r_cap)  # (r_cap, N)
+    return step
+
+
+def frontier_x0(rows_by) -> jax.Array:
+    """X(0): every non-empty chain's first event is root-attached with
+    round 0 (base grids)."""
+    l = rows_by.shape[1]
+    return jnp.where(rows_by[:, 0] >= 0, 0, jnp.int32(l)).astype(jnp.int32)
+
+
+def _frontier_rounds(
+    inv_f32, rows_by, creator, index, sp_index, fd, super_majority: int,
+    r_cap: int, la=None,
+) -> FrontierResult:
+    step = make_walk_step(inv_f32, rows_by, fd, la, super_majority)
+
+    def body(x_cur, _):
+        return step(x_cur), x_cur
+
+    _, x_hist = jax.lax.scan(
+        body, frontier_x0(rows_by), None, length=r_cap
+    )  # (r_cap, N)
     return frontier_post(x_hist, rows_by, creator, index, sp_index)
 
 
@@ -256,7 +360,8 @@ def frontier_pipeline(
     d_cap optionally caps the fame voting offset (the static safety net of
     the scan pipeline); default = r_cap + 2."""
     fr = _frontier_rounds(
-        inv_f32, rows_by, creator, index, sp_index, fd, super_majority, r_cap
+        inv_f32, rows_by, creator, index, sp_index, fd, super_majority, r_cap,
+        la=la,
     )
     fame = _decide_fame(
         fr.witness_table, la, fd, index, coin_bit, fr.last_round,

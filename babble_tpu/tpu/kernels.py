@@ -174,16 +174,13 @@ def _divide_rounds(
     return DivideRoundsResult(rounds, witness, lamport, wtable)
 
 
-def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int):
-    """Shared DecideFame preamble: the round-adjacent strongly-see tensor
-    and the d=1 ancestry votes (reference: hashgraph.go:875-884)."""
-    r_max, n = wtable.shape
-    wvalid = wtable >= 0
-    wrows = jnp.maximum(wtable, 0)
-    la_w = la[wrows]  # (R, N, N) lastAncestors of each round's witnesses
-    fd_w = fd[wrows]  # (R, N, N)
-    idx_w = index[wrows]  # (R, N)
-    coin_w = coin_bit[wrows]  # (R, N)
+def _fame_setup_tables(wvalid, la_w, fd_w, idx_w, coin_w, super_majority: int):
+    """DecideFame preamble from prebuilt per-witness tables: the
+    round-adjacent strongly-see tensor and the d=1 ancestry votes
+    (reference: hashgraph.go:875-884). Split out so callers that keep
+    dense witness buffers (frontier_live.py, which derives fd_w from INV)
+    can skip the row gathers."""
+    r_max, n = wvalid.shape
 
     # ss[j, y, w]: witness y of round j strongly sees witness w of round j-1
     fd_prev = jnp.roll(fd_w, 1, axis=0)
@@ -200,16 +197,25 @@ def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int):
     return ss, votes0, wvalid, coin_w
 
 
-def _decide_fame(
-    wtable, la, fd, index, coin_bit, last_round,
+def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int):
+    """Shared DecideFame preamble: gather per-witness tables, then the
+    table math (_fame_setup_tables)."""
+    wvalid = wtable >= 0
+    wrows = jnp.maximum(wtable, 0)
+    return _fame_setup_tables(
+        wvalid, la[wrows], fd[wrows], index[wrows], coin_bit[wrows],
+        super_majority,
+    )
+
+
+def _decide_fame_tables(
+    ss, votes0, wvalid, coin_w, last_round,
     super_majority: int, n_participants: int, d_cap: int,
 ) -> FameResult:
-    """Virtual voting, batched over every round i at once; while_loop over
-    the round offset d (j = i + d) with bit-exact early exit."""
-    r_max, n = wtable.shape
-    ss, votes0, wvalid, coin_w = _fame_setup(
-        wtable, la, fd, index, coin_bit, super_majority
-    )
+    """Virtual voting from a prebuilt strongly-see tensor, batched over
+    every round i at once; while_loop over the round offset d (j = i + d)
+    with bit-exact early exit."""
+    r_max, n = wvalid.shape
 
     i_arr = jnp.arange(r_max)
 
@@ -271,16 +277,29 @@ def _decide_fame(
     return FameResult(decided, famous, rounds_decided)
 
 
-def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
-    """Per-round tables consumed by the round-received search: famous-witness
-    counts, column minima of famous witnesses' lastAncestors, eligibility,
-    and the first-undecided-round suffix scan."""
-    r_max, n = wtable.shape
-    is_famous = decided & famous & (wtable >= 0)  # (R, N)
+def _decide_fame(
+    wtable, la, fd, index, coin_bit, last_round,
+    super_majority: int, n_participants: int, d_cap: int,
+) -> FameResult:
+    """Virtual voting with tables gathered from the flat event arrays."""
+    ss, votes0, wvalid, coin_w = _fame_setup(
+        wtable, la, fd, index, coin_bit, super_majority
+    )
+    return _decide_fame_tables(
+        ss, votes0, wvalid, coin_w, last_round,
+        super_majority, n_participants, d_cap,
+    )
+
+
+def _received_tables_from(wvalid, la_w, decided, famous, rounds_decided,
+                          last_round):
+    """Per-round received-search tables from prebuilt per-witness tables
+    (for callers that keep dense witness buffers)."""
+    r_max = wvalid.shape[0]
+    is_famous = decided & famous & wvalid  # (R, N)
     famous_count = jnp.sum(is_famous, axis=1)  # (R,)
 
     # min over famous witnesses of lastAnc[w][c] per (round, creator-column)
-    la_w = la[jnp.maximum(wtable, 0)]  # (R, N_w, N_c)
     min_la = jnp.min(
         jnp.where(is_famous[:, :, None], la_w, MAX_INT32), axis=1
     )  # (R, N_c)
@@ -292,6 +311,16 @@ def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
     bad = jnp.where(~i_ok, idx, r_max)
     horizon = suffix_min(bad, r_max)  # (R,)
     return min_la, famous_count, i_ok, horizon
+
+
+def _received_tables(wtable, la, decided, famous, rounds_decided, last_round):
+    """Per-round tables consumed by the round-received search: famous-witness
+    counts, column minima of famous witnesses' lastAncestors, eligibility,
+    and the first-undecided-round suffix scan."""
+    return _received_tables_from(
+        wtable >= 0, la[jnp.maximum(wtable, 0)], decided, famous,
+        rounds_decided, last_round,
+    )
 
 
 def received_core(index, rounds, seen_min, famous_count, i_ok, horizon_start):

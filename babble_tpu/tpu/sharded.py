@@ -309,16 +309,24 @@ def _sharded_build_inv_fn(mesh: Mesh, axis: str):
 def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
                       l: int):
     """shard_mapped frontier walk: INV and the chain table sharded over
-    chains; fd replicated; the whole r_cap-step scan runs in ONE dispatch
-    with two (N/ndev,)-sized all-gathers per step riding ICI."""
+    chains; fd/la replicated; the whole r_cap-step scan runs in ONE
+    dispatch with two (N/ndev,)-sized all-gathers per step riding ICI.
+    The m0 stage mirrors the single-device form switch (frontier.py):
+    einsum+sort for small N, per-chain binary search for large N (the
+    sort form materializes (N, N/ndev, N) per device — 500+ MB at
+    N=1024 even sharded)."""
+    from .frontier import M0_BINSEARCH_MIN_N, _m0_binsearch
 
-    def local_walk(inv_local, rb_local, fd, x0_local):
-        # (B, N_p, L), (B, L), (E, N_p) replicated, (B,)
+    def local_walk(inv_local, rb_local, fd, la, x0_local):
+        # (B, N_p, L), (B, L), (E, N_p) replicated, (E, N_p) replicated, (B,)
         b = rb_local.shape[0]
+        n_total = b * int(np.prod(mesh.devices.shape))
         sent = jnp.int32(l)
         rb = jnp.maximum(rb_local, 0)
         vv = jnp.arange(l)
         bb = jnp.arange(b)
+        use_binsearch = n_total >= M0_BINSEARCH_MIN_N
+        chain_len = jnp.sum(rb_local >= 0, axis=1).astype(jnp.int32)
 
         def step(x_local, _):
             # my chains' frontier rows -> their fd coordinate vectors
@@ -329,23 +337,32 @@ def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
             # every device needs every frontier row's coordinates to test
             # its own chains against: gather the small (N, N_p) int table
             fd_w = jax.lax.all_gather(fd_w_local, axis, tiled=True)
+            w_ok_all = jax.lax.all_gather(w_ok, axis, tiled=True)
 
-            # u[w, c_local, p] = first local-chain-c index whose
-            # p-coordinate reaches fd_w[w, p] — one-hot MXU contraction
-            # against the LOCAL INV shard only (1/ndev of the FLOPs)
-            oh = (
-                jnp.clip(fd_w, 0, l - 1)[:, :, None] == vv[None, None, :]
-            ).astype(jnp.float32)  # (N_w, N_p, L)
-            u = jnp.einsum(
-                "wpv,cpv->wcp", oh, inv_local,
-                precision=jax.lax.Precision.HIGHEST,
-            ).astype(jnp.int32)
-            u = jnp.where((fd_w < MAX_INT32)[:, None, :], u, sent)
+            if use_binsearch:
+                # first local-chain index strongly seeing a supermajority
+                # of ALL frontier rows — same probe math as the
+                # single-device walk, restricted to this device's chains
+                m0_local = _m0_binsearch(
+                    fd_w, w_ok_all, rb, chain_len, la, super_majority, l
+                )
+            else:
+                # u[w, c_local, p] = first local-chain-c index whose
+                # p-coordinate reaches fd_w[w, p] — one-hot MXU contraction
+                # against the LOCAL INV shard only (1/ndev of the FLOPs)
+                oh = (
+                    jnp.clip(fd_w, 0, l - 1)[:, :, None] == vv[None, None, :]
+                ).astype(jnp.float32)  # (N_w, N_p, L)
+                u = jnp.einsum(
+                    "wpv,cpv->wcp", oh, inv_local,
+                    precision=jax.lax.Precision.HIGHEST,
+                ).astype(jnp.int32)
+                u = jnp.where((fd_w < MAX_INT32)[:, None, :], u, sent)
 
-            # t[w, c_local] = first local-chain index strongly seeing
-            # frontier row w; m0 = supermajority-th smallest over w
-            t = jnp.sort(u, axis=2)[:, :, super_majority - 1]
-            m0_local = jnp.sort(t, axis=0)[super_majority - 1, :]  # (B,)
+                # t[w, c_local] = first local-chain index strongly seeing
+                # frontier row w; m0 = supermajority-th smallest over w
+                t = jnp.sort(u, axis=2)[:, :, super_majority - 1]
+                m0_local = jnp.sort(t, axis=0)[super_majority - 1, :]  # (B,)
             m0 = jax.lax.all_gather(m0_local, axis, tiled=True)  # (N,)
 
             # cross-chain closure, one pass (coordinate transitivity) —
@@ -372,7 +389,7 @@ def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
         jax.shard_map(
             local_walk,
             mesh=mesh,
-            in_specs=(P(axis, None, None), P(axis, None), P(), P(axis)),
+            in_specs=(P(axis, None, None), P(axis, None), P(), P(), P(axis)),
             out_specs=P(None, axis),
         )
     )
@@ -433,7 +450,7 @@ def sharded_frontier_passes(
     )
     while True:
         x_hist = _frontier_walk_fn(mesh, axis, grid.super_majority, r_cap, l_b)(
-            inv, rb_dev, fd, x0
+            inv, rb_dev, fd, la, x0
         )
 
         # ---- pass 1c: witness table + per-event rounds (shared post-walk) --

@@ -1,0 +1,163 @@
+"""Scale-point benchmark: the round-frontier pipeline at BASELINE config #5's
+validator count (1024 validators; reference scale axis: BASELINE.json
+`configs[4]` — "streaming rounds with on-device DAG frontier").
+
+Complements bench.py (the 64-validator metric of record): same timed path,
+same in-run bit-exactness gate vs the level-scan engine, at the largest
+configured validator scale. Run on the real chip for the recorded scale
+point; the multi-chip analog of this shape is exercised by the CPU-mesh
+differential (tests/test_multichip.py::test_frontier_sharded_n256 and the
+8-way run recorded in BASELINE.md).
+
+Prints one JSON line like bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_VALIDATORS = 1024
+N_EVENTS = 32768
+SEED = 7
+ZIPF = 1.02
+
+CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "bench_cache",
+    f"grid_{N_VALIDATORS}x{N_EVENTS}_seed{SEED}.npz",
+)
+
+
+def load_grid():
+    import numpy as np
+
+    from babble_tpu.tpu.grid import DagGrid, MIN_INT32, build_levels, synthetic_grid
+
+    if os.path.exists(CACHE):
+        z = np.load(CACHE)
+        e = N_EVENTS
+        levels, num_levels = build_levels(
+            N_VALIDATORS, z["self_parent"], z["other_parent"]
+        )
+        return DagGrid(
+            n=N_VALIDATORS,
+            e=e,
+            super_majority=2 * N_VALIDATORS // 3 + 1,
+            creator=z["creator"],
+            index=z["index"],
+            self_parent=z["self_parent"],
+            other_parent=z["other_parent"],
+            last_ancestors=z["la"],
+            first_descendants=z["fd"],
+            coin_bit=z["coin"],
+            fixed_round=np.where(
+                (z["self_parent"] < 0) & (z["other_parent"] < 0), 0, -1
+            ).astype(np.int32),
+            ext_sp_round=np.full(e, -1, dtype=np.int32),
+            ext_op_round=np.full(e, -1, dtype=np.int32),
+            ext_sp_lamport=np.full(e, -1, dtype=np.int32),
+            ext_op_lamport=np.full(e, MIN_INT32, dtype=np.int32),
+            fixed_lamport=np.full(e, MIN_INT32, dtype=np.int32),
+            levels=levels,
+            num_levels=num_levels,
+        )
+
+    grid = synthetic_grid(N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=ZIPF)
+    os.makedirs(os.path.dirname(CACHE), exist_ok=True)
+    np.savez_compressed(
+        CACHE,
+        creator=grid.creator,
+        index=grid.index,
+        self_parent=grid.self_parent,
+        other_parent=grid.other_parent,
+        la=grid.last_ancestors,
+        fd=grid.first_descendants,
+        coin=grid.coin_bit,
+    )
+    return grid
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from babble_tpu.tpu.engine import run_passes
+    from babble_tpu.tpu.frontier import (
+        build_inv, chain_table, frontier_pipeline, level_lamport, sp_index_of,
+    )
+
+    grid = load_grid()
+
+    dev = {
+        k: jax.device_put(getattr(grid, k))
+        for k in (
+            "creator", "index", "last_ancestors", "first_descendants",
+            "coin_bit",
+        )
+    }
+    rows_by = chain_table(grid)
+    dev["rows_by"] = jax.device_put(rows_by)
+    dev["sp_index"] = jax.device_put(sp_index_of(grid))
+    dev["lamport"] = jax.device_put(level_lamport(grid))
+    inv = build_inv(dev["rows_by"], dev["last_ancestors"])
+
+    # the fame/received round axis: at 1024 validators real round counts
+    # are tiny (few events per chain), so a small N-independent axis wins
+    # (see engine._adaptive_r_loop's floor note)
+    r_fame = 16
+
+    def run_batch():
+        return frontier_pipeline(
+            inv, dev["rows_by"], dev["creator"], dev["index"],
+            dev["sp_index"], dev["last_ancestors"], dev["first_descendants"],
+            dev["lamport"], dev["coin_bit"],
+            grid.super_majority, grid.n, r_fame,
+        )
+
+    out = run_batch()
+    while int(np.asarray(out.last_round)) + 2 > r_fame:
+        r_fame *= 2
+        out = run_batch()
+
+    warm = jnp.int32(0)
+    for _ in range(15):
+        warm = warm + run_batch().last_round
+    int(np.asarray(warm))
+
+    iters = 20
+    start = time.perf_counter()
+    acc = jnp.int32(0)
+    for _ in range(iters):
+        out = run_batch()
+        acc = acc + out.last_round + jnp.sum(out.received) + jnp.sum(out.rounds)
+    int(np.asarray(acc))
+    elapsed = (time.perf_counter() - start) / iters
+
+    # bit-exactness gate vs the level-scan engine path
+    res = run_passes(grid, adaptive_r=True)
+    np.testing.assert_array_equal(np.asarray(out.rounds), res.rounds)
+    np.testing.assert_array_equal(np.asarray(out.received), res.received)
+
+    events_per_sec = grid.e / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "events ordered/sec, frontier pipeline, "
+                    f"{N_VALIDATORS} validators (BASELINE config #5 scale), "
+                    f"{N_EVENTS} events, platform={jax.devices()[0].platform}"
+                ),
+                "value": round(events_per_sec, 1),
+                "unit": "events/s",
+                "vs_baseline": round(events_per_sec / 1_000_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

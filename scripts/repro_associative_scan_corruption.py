@@ -1,0 +1,41 @@
+"""Minimal repro: jax.lax.associative_scan(min, reverse=True) silently
+produced corrupt suffix minima on the TPU platform at ~2800-length axes
+(observed on v5e, jax 0.9.0) — the reason babble_tpu.tpu.kernels.suffix_min
+exists as an explicit log-step shift-doubling instead.
+
+Run on a TPU host:
+    python scripts/repro_associative_scan_corruption.py
+Healthy output ends with "associative_scan MATCHES numpy" on every shape;
+the corruption manifests as a nonzero mismatch count at the larger shapes
+(no exception — that is what makes it dangerous).
+
+Pinned by tests/test_frontier.py::test_suffix_min_matches_numpy, which
+asserts the replacement (suffix_min) against a numpy oracle at the same
+shapes, so the workaround cannot be "simplified" back to associative_scan
+without the suite noticing.
+"""
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.devices()[0].platform)
+    rng = np.random.default_rng(0)
+    for shape in [(4, 5, 128), (4, 5, 1024), (4, 5, 2048), (4, 5, 2801),
+                  (4, 5, 4096)]:
+        x = rng.integers(0, 3000, size=shape).astype(np.int32)
+        got = np.asarray(
+            jax.lax.associative_scan(jnp.minimum, jnp.asarray(x),
+                                     reverse=True, axis=2)
+        )
+        want = np.minimum.accumulate(x[:, :, ::-1], axis=2)[:, :, ::-1]
+        bad = int((got != want).sum())
+        verdict = "MATCHES numpy" if bad == 0 else f"CORRUPT ({bad} cells)"
+        print(f"shape {shape}: associative_scan {verdict}")
+
+
+if __name__ == "__main__":
+    main()

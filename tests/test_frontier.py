@@ -73,3 +73,41 @@ def test_suffix_min_matches_numpy():
     got = np.asarray(suffix_min(x, 3000, axis=2))
     want = np.minimum.accumulate(x[:, :, ::-1], axis=2)[:, :, ::-1]
     np.testing.assert_array_equal(got, want)
+
+
+def test_m0_binsearch_matches_sort():
+    """The two m0 formulations (einsum+sort for small N, binary-search for
+    large N — frontier.M0_BINSEARCH_MIN_N) must agree exactly: force the
+    binsearch path on small-N grids and differential the walk against the
+    sort-based walk. Calls the UNJITTED walk — the jitted pipeline's cache
+    does not key on the module flag, so a monkeypatched run through it
+    could silently reuse the sort-path executable."""
+    from babble_tpu.tpu import frontier
+
+    orig = frontier.M0_BINSEARCH_MIN_N
+    try:
+        for n, e, seed, zipf in [(8, 256, 2, 0.0), (16, 1024, 4, 1.1),
+                                 (8, 300, 7, 2.0)]:
+            grid = synthetic_grid(n, e, seed=seed, zipf_a=zipf)
+            import jax.numpy as jnp
+
+            rows_by = chain_table(grid)
+            inv = build_inv(rows_by, grid.last_ancestors)
+            args = (
+                inv, jnp.asarray(rows_by), jnp.asarray(grid.creator),
+                jnp.asarray(grid.index), jnp.asarray(sp_index_of(grid)),
+                jnp.asarray(grid.first_descendants), grid.super_majority, 64,
+            )
+            la_dev = jnp.asarray(grid.last_ancestors)
+            frontier.M0_BINSEARCH_MIN_N = 1 << 30  # force sort
+            a = frontier._frontier_rounds(*args, la=la_dev)
+            frontier.M0_BINSEARCH_MIN_N = 1  # force binsearch
+            b = frontier._frontier_rounds(*args, la=la_dev)
+            np.testing.assert_array_equal(np.asarray(a.rounds), np.asarray(b.rounds))
+            np.testing.assert_array_equal(np.asarray(a.witness), np.asarray(b.witness))
+            np.testing.assert_array_equal(
+                np.asarray(a.witness_table), np.asarray(b.witness_table)
+            )
+            assert int(a.last_round) == int(b.last_round)
+    finally:
+        frontier.M0_BINSEARCH_MIN_N = orig
