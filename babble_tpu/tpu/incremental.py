@@ -514,6 +514,10 @@ class Train(NamedTuple):
     upd_col: jax.Array  # (U,) int32
     upd_val: jax.Array  # (U,) int32
     levels: jax.Array  # (T, W) int32 train-local positions, -1 padding
+    # host-maintained lamport timestamps (the insert path knows parents'
+    # lamports at insert time); the level-scan train body computes its own
+    # on device and ignores this, the frontier-live engine consumes it
+    lamport: jax.Array  # (KB,) int32
 
 
 def _train_body(state: IncState, train: Train, super_majority: int,
@@ -860,6 +864,9 @@ def trains_from_grid(grid: DagGrid, train_size: int, upd_cap: int,
     whole-train analog of batches_from_grid). Trains whose dependency
     depth or fd-update burst exceeds the caps are split in half."""
     assert grid.fd_update_stream is not None, "need record_fd_updates=True"
+    from .frontier import level_lamport
+
+    lamport_all = level_lamport(grid)
     spans = [
         (s, min(s + train_size, grid.e))
         for s in range(0, grid.e, train_size)
@@ -910,6 +917,7 @@ def trains_from_grid(grid: DagGrid, train_size: int, upd_cap: int,
             op_pos=_pad1(op_pos, pad, -1),
             upd_row=urow, upd_col=ucol, upd_val=uval,
             levels=_pad_rows(table, t_cap),
+            lamport=_pad1(lamport_all[rows], pad, -1),
             **_grid_slice_fields(grid, rows, pad),
         ))
     return out

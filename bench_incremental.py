@@ -1,16 +1,18 @@
 """Append-mode benchmark: gossip-sized increments through the persistent
-device pipeline (babble_tpu/tpu/incremental.py).
+device pipelines.
 
-Measures sustained end-to-end throughput of appending gossip batches to
-device-resident DAG state — the live-node dispatch pattern with dispatch
-trains — and checks the final rounds/received bit-exactly against the
-one-shot pipeline on the same DAG.
+Measures sustained end-to-end throughput of appending event trains to
+device-resident DAG state — the live-node dispatch pattern — and checks
+the final rounds/received bit-exactly against the one-shot pipeline on
+the same DAG. Two engines:
 
-The device program is the Train path: a whole train of appended events is
-one XLA program whose sequential axis is the train's dependency-level
-table, with every carry-dependent gather expressed as a one-hot MXU
-matmul (data-dependent row gathers serialize into per-row DMAs) and all
-witness-buffer registration replayed as one bulk scatter after the scan.
+- **frontier-live** (babble_tpu/tpu/frontier_live.py, the metric of
+  record): INV/chain tables maintained incrementally per train (scatter +
+  suffix-min re-closure), then the round-frontier walk + fame + received —
+  sequential axis = round count, no per-event device work.
+- **train** (babble_tpu/tpu/incremental.py, reported for comparison; set
+  BENCH_INC_MODE=train to emit it as the JSON line): level-scan over the
+  train's dependency-level table with one-hot MXU gathers.
 
 Prints one JSON line like bench.py; this is the secondary metric
 (BASELINE.md incremental target: >= 100k events/s).
@@ -37,36 +39,19 @@ SEED = 0
 TARGET = 100_000.0
 
 
-def main():
-    import jax
+def _run_train_mode(grid, trains, e_cap):
+    """Level-scan incremental engine (incremental.py Train path)."""
     import jax.numpy as jnp
     import numpy as np
 
-    from babble_tpu.tpu import synthetic_grid
-    from babble_tpu.tpu.incremental import (
-        init_state,
-        train_step,
-        trains_from_grid,
-    )
+    from babble_tpu.tpu.incremental import init_state, train_step
 
-    grid = synthetic_grid(
-        N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=1.1, record_fd_updates=True
-    )
-    e_cap = N_EVENTS
     r_cap = 64
-    trains = [
-        jax.device_put(t)
-        for t in trains_from_grid(grid, TRAIN, UPD_CAP, e_cap, t_cap=T_CAP)
-    ]
-
-    # warm-up: full replay once (compiles the step, ramps the chip)
     state = init_state(grid.n, e_cap, r_cap)
     for t in trains:
         state = train_step(state, t, grid.super_majority, grid.n, e_win=E_WIN)
-    warm_rounds = np.asarray(state.rounds)  # sync
+    np.asarray(state.rounds)  # sync (compile + chip ramp)
 
-    # timed replays: sustained throughput = best of 3 full replays (the
-    # first post-compile replay pays one-time tunnel/allocator setup)
     elapsed = float("inf")
     for _ in range(3):
         state = init_state(grid.n, e_cap, r_cap)
@@ -75,23 +60,82 @@ def main():
             state = train_step(
                 state, t, grid.super_majority, grid.n, e_win=E_WIN
             )
-        # force completion of the whole replay through a dependent scalar
         acc = int(np.asarray(
             state.last_round + jnp.sum(state.rounds) + jnp.sum(state.received)
         ))
         elapsed = min(elapsed, time.perf_counter() - start)
     assert not bool(state.stale), "received window undersized (stale latch)"
     assert not bool(state.fame_lag), "fame unroll exceeded (fame_lag latch)"
+    return state, elapsed, "train dispatch (level scan)"
+
+
+def _run_frontier_mode(grid, trains, e_cap):
+    """Frontier-live engine: incrementally-maintained INV/chain tables +
+    the round-frontier walk per train (frontier_live.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from babble_tpu.tpu.frontier_live import (
+        frontier_train_step, init_frontier_state,
+    )
+
+    l_cap = 4096  # covers the hottest Zipf chain at this config (~1.5k);
+    #               NB: 2048 measured SLOWER (lane-axis tiling pathology)
+    r_cap = 128  # round axis; the r_over latch turns an undersizing into
+    #              a visible failure
+    sm, n = grid.super_majority, grid.n
+
+    state = init_frontier_state(n, e_cap, l_cap, r_cap)
+    for t in trains:
+        state = frontier_train_step(state, t, sm, n)
+    np.asarray(state.rounds)  # sync (compile + chip ramp)
+
+    elapsed = float("inf")
+    for _ in range(3):
+        state = init_frontier_state(n, e_cap, l_cap, r_cap)
+        start = time.perf_counter()
+        for t in trains:
+            state = frontier_train_step(state, t, sm, n)
+        acc = int(np.asarray(
+            state.last_round + jnp.sum(state.rounds) + jnp.sum(state.received)
+        ))
+        elapsed = min(elapsed, time.perf_counter() - start)
+    assert not bool(state.l_over), "chain index axis exhausted (l_over)"
+    assert not bool(state.r_over), "round window exhausted (r_over)"
+    assert not bool(state.frozen_violation), "frozen-round violation latch"
+    return state, elapsed, "frontier-live (incremental INV + frontier walk)"
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from babble_tpu.tpu import synthetic_grid
+    from babble_tpu.tpu.incremental import trains_from_grid
+
+    grid = synthetic_grid(
+        N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=1.1, record_fd_updates=True
+    )
+    e_cap = N_EVENTS
+    trains = [
+        jax.device_put(t)
+        for t in trains_from_grid(grid, TRAIN, UPD_CAP, e_cap, t_cap=T_CAP)
+    ]
+
+    mode = os.environ.get("BENCH_INC_MODE", "frontier")
+    runner = _run_frontier_mode if mode == "frontier" else _run_train_mode
+    state, elapsed, label = runner(grid, trains, e_cap)
     events_per_sec = grid.e / elapsed
 
     # differential gate vs the one-shot pipeline
     from babble_tpu.tpu.engine import run_passes
 
     ref = run_passes(grid, adaptive_r=True)
-    np.testing.assert_array_equal(np.asarray(state.rounds), ref.rounds)
-    np.testing.assert_array_equal(np.asarray(state.lamport), ref.lamport)
-    np.testing.assert_array_equal(np.asarray(state.witness), ref.witness)
-    np.testing.assert_array_equal(np.asarray(state.received), ref.received)
+    e = grid.e
+    np.testing.assert_array_equal(np.asarray(state.rounds)[:e], ref.rounds)
+    np.testing.assert_array_equal(np.asarray(state.lamport)[:e], ref.lamport)
+    np.testing.assert_array_equal(np.asarray(state.witness)[:e], ref.witness)
+    np.testing.assert_array_equal(np.asarray(state.received)[:e], ref.received)
     assert int(state.last_round) == ref.last_round
 
     print(
@@ -99,7 +143,7 @@ def main():
             {
                 "metric": (
                     "events/sec appended through persistent device DAG "
-                    f"state, train dispatch, {N_VALIDATORS} "
+                    f"state, {label}, {N_VALIDATORS} "
                     f"validators, platform={jax.devices()[0].platform}"
                 ),
                 "value": round(events_per_sec, 1),

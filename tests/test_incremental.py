@@ -131,3 +131,89 @@ def test_stale_latch_fires_on_undersized_window():
     for b in batches:
         st = step(st, b, grid.super_majority, n, e_win=64)  # far too small
     assert bool(st.stale)
+
+
+# -- frontier-live engine (incremental INV + frontier walk) ------------------
+
+
+def frontier_replay(grid, train_size, e_cap=4096, l_cap=256, r_cap=64):
+    from babble_tpu.tpu.frontier_live import (
+        frontier_train_step, init_frontier_state,
+    )
+
+    trains = trains_from_grid(grid, train_size, 16384, e_cap)
+    state = init_frontier_state(grid.n, e_cap, l_cap, r_cap)
+    for t in trains:
+        state = frontier_train_step(state, t, grid.super_majority, grid.n)
+    assert not bool(state.l_over) and not bool(state.r_over)
+    assert not bool(state.frozen_violation)
+    return state
+
+
+@pytest.mark.parametrize("zipf", [0.0, 1.1])
+def test_frontier_live_matches_one_shot(zipf):
+    """The frontier-live engine's final state after train-sized appends
+    must equal the one-shot pipeline on the same DAG — the claim that
+    incrementally-maintained INV/chain tables reproduce build_inv."""
+    grid = synthetic_grid(16, 2048, seed=3, zipf_a=zipf, record_fd_updates=True)
+    state = frontier_replay(grid, 256)
+    ref = run_passes(grid, adaptive_r=True)
+    e = grid.e
+    np.testing.assert_array_equal(np.asarray(state.rounds)[:e], ref.rounds)
+    np.testing.assert_array_equal(np.asarray(state.witness)[:e], ref.witness)
+    np.testing.assert_array_equal(np.asarray(state.lamport)[:e], ref.lamport)
+    np.testing.assert_array_equal(np.asarray(state.received)[:e], ref.received)
+    assert int(state.last_round) == ref.last_round
+
+
+def test_frontier_live_small_trains_match_large():
+    """Train-size independence: appending 32 events at a time must land in
+    exactly the same state as 512 at a time (INV closure and frontier
+    decisions are pure functions of the accumulated tables)."""
+    grid = synthetic_grid(8, 1024, seed=9, zipf_a=1.1, record_fd_updates=True)
+    a = frontier_replay(grid, 32)
+    b = frontier_replay(grid, 512)
+    for field in ("rounds", "witness", "received", "wtable",
+                  "fame_decided", "famous"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        )
+
+
+def test_frontier_multi_train_matches_per_train():
+    from babble_tpu.tpu.frontier_live import (
+        frontier_multi_train, frontier_train_step, init_frontier_state,
+    )
+    from babble_tpu.tpu.incremental import stack_trains
+
+    grid = synthetic_grid(8, 1024, seed=5, zipf_a=1.1, record_fd_updates=True)
+    e_cap, l_cap, r_cap = 2048, 256, 64
+    trains = trains_from_grid(grid, 128, 16384, e_cap)
+
+    a = init_frontier_state(grid.n, e_cap, l_cap, r_cap)
+    for t in trains:
+        a = frontier_train_step(a, t, grid.super_majority, grid.n)
+
+    b = init_frontier_state(grid.n, e_cap, l_cap, r_cap)
+    b = frontier_multi_train(
+        b, stack_trains(trains), grid.super_majority, grid.n
+    )
+    for field in ("rounds", "witness", "received", "last_round"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        )
+
+
+def test_frontier_live_l_over_latch():
+    """A chain outgrowing the index axis must latch l_over, not corrupt."""
+    from babble_tpu.tpu.frontier_live import (
+        frontier_train_step, init_frontier_state,
+    )
+
+    grid = synthetic_grid(8, 512, seed=2, zipf_a=2.0, record_fd_updates=True)
+    l_cap = 16  # far below the hottest chain's length
+    trains = trains_from_grid(grid, 128, 16384, 1024)
+    state = init_frontier_state(grid.n, 1024, l_cap, 64)
+    for t in trains:
+        state = frontier_train_step(state, t, grid.super_majority, grid.n)
+    assert bool(state.l_over)
