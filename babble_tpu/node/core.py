@@ -52,13 +52,20 @@ class Core:
         self.consensus_backend = consensus_backend
         self.device_consensus_runs = 0
         self.device_consensus_fallbacks = 0
+        # live-engine health: demotions (live -> one-shot falls) and
+        # re-attaches are counted and surfaced in /stats; a demotion is
+        # NOT sticky — the live engine is retried with bounded backoff
+        # (the frontier attach can rebuild it from any settled state,
+        # including post-fast-sync and deep-history restarts)
+        self.live_demotions = 0
+        self.live_reattaches = 0
+        self._consensus_calls = 0
+        self._live_retry_at = 0  # next _consensus_calls value to retry at
+        self._live_backoff = 1
         # sticky: set when the hashgraph state stops being grid-expressible
         # (e.g. a rolled store window); cleared on fast-forward, which
         # compacts the state back into grid range
         self._device_down = False
-        # sticky: the incremental live engine hit an unsupported state
-        # (post-reset, capacity) — use the one-shot device path instead
-        self._live_down = False
 
     # -- identity ----------------------------------------------------------
 
@@ -187,7 +194,14 @@ class Core:
             self.hg.apply_section(section)
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
-        self._live_down = True  # post-reset states stay one-shot
+        # the live engine's device state is desynced from the reset store:
+        # drop it (a demotion, visible in /stats), and re-attach (the
+        # frontier assembly handles post-reset states) after one one-shot
+        # call lets the reset settle
+        if getattr(self.hg, "_live_device_engine", None) is not None:
+            self.live_demotions += 1
+        self._drop_live_engine()
+        self._live_retry_at = self._consensus_calls + 2
         self.run_consensus()
 
     def fast_forward(
@@ -230,23 +244,42 @@ class Core:
             from ..tpu.engine import run_consensus_device
             from ..tpu.grid import GridUnsupported
 
-            if not self._live_down:
+            self._consensus_calls += 1
+            if self._consensus_calls >= self._live_retry_at:
                 from ..tpu.live import run_consensus_live
 
+                attached = (
+                    getattr(self.hg, "_live_device_engine", None) is not None
+                )
                 try:
                     run_consensus_live(self.hg)
                     self.device_consensus_runs += 1
+                    if not attached and self.live_demotions > 0:
+                        self.live_reattaches += 1
+                        self.logger.info(
+                            "incremental device engine re-attached "
+                            "(demotions=%d)", self.live_demotions,
+                        )
+                    self._live_backoff = 1
                     return
                 except Exception as e:  # noqa: BLE001 — any failure leaves
                     # the engine's device state desynced from its host
                     # bookkeeping: drop it entirely (the one-shot path
                     # recomputes from the store, so nothing is lost) and
-                    # stop retrying
-                    self._live_down = True
-                    eng = getattr(self.hg, "_live_device_engine", None)
-                    if eng is not None:
-                        eng.detach()
-                        self.hg._live_device_engine = None
+                    # retry the attach with bounded backoff — the frontier
+                    # assembly can rebuild from any settled state, so
+                    # demotion is a pause, not a sentence. Only a fall of
+                    # an ATTACHED engine is a demotion; a failed re-attach
+                    # attempt just extends the backoff (else the counter
+                    # grows without bound on permanently-unsupported
+                    # states and stops meaning "engine dropped").
+                    if attached:
+                        self.live_demotions += 1
+                    self._live_backoff = min(self._live_backoff * 2, 64)
+                    self._live_retry_at = (
+                        self._consensus_calls + self._live_backoff
+                    )
+                    self._drop_live_engine()
                     log = (
                         self.logger.info
                         if isinstance(e, GridUnsupported)
@@ -254,7 +287,8 @@ class Core:
                     )
                     log(
                         "incremental device engine unavailable (%s); "
-                        "one-shot device path", e
+                        "one-shot device path, retry in %d calls",
+                        e, self._live_backoff,
                     )
             try:
                 run_consensus_device(self.hg)
@@ -270,6 +304,12 @@ class Core:
                     "next fast-forward", e
                 )
         self.hg.run_consensus()
+
+    def _drop_live_engine(self) -> None:
+        eng = getattr(self.hg, "_live_device_engine", None)
+        if eng is not None:
+            eng.detach()
+            self.hg._live_device_engine = None
 
     def add_transactions(self, txs: List[bytes]) -> None:
         self.transaction_pool.extend(txs)

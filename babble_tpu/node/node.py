@@ -462,6 +462,27 @@ class Node(NodeStateMachine):
             "consensus_backend": self.core.consensus_backend,
             "device_consensus_runs": str(self.core.device_consensus_runs),
             "device_consensus_fallbacks": str(self.core.device_consensus_fallbacks),
+            # live-engine health: demotions to the one-shot path and
+            # successful re-attaches (an operator watching /stats can see
+            # a degraded TPU node AND see it heal)
+            "live_engine_demotions": str(self.core.live_demotions),
+            "live_engine_reattaches": str(self.core.live_reattaches),
+            **self._live_engine_stats(),
+        }
+
+    def _live_engine_stats(self):
+        """Latency budget of the live device path (BASELINE.md): dispatch
+        wall time (host-side program launches) vs fetch wall time (the
+        per-sync result round trip — where tunnel RTT lands)."""
+        eng = getattr(self.core.hg, "_live_device_engine", None)
+        if eng is None or eng.consensus_calls == 0:
+            return {}
+        calls = eng.consensus_calls
+        return {
+            "device_dispatches": str(eng.dispatches),
+            "device_dispatch_ms_avg": f"{eng.dispatch_seconds / max(eng.dispatches, 1) * 1e3:.2f}",
+            "device_fetch_ms_avg": f"{eng.fetch_seconds / calls * 1e3:.2f}",
+            "device_rebases": str(eng.rebases),
         }
 
     def log_stats(self) -> None:

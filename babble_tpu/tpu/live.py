@@ -91,6 +91,14 @@ class LiveDeviceEngine:
         self.e_win = min(d["e_win"] if e_win is None else e_win, self.e_cap)
         self.round_base = 0
         self.rebases = 0
+        # latency accounting (surfaced via /stats): device dispatches,
+        # host wall time spent dispatching vs fetching results — the
+        # breakdown that separates tunnel RTT from compute (BASELINE.md
+        # live-path latency budget)
+        self.dispatches = 0
+        self.dispatch_seconds = 0.0
+        self.fetch_seconds = 0.0
+        self.consensus_calls = 0
         self.state: IncState = init_state(self.n, self.e_cap, self.r_cap)
         self.row_of: Dict[str, int] = {}
         self.hashes: List[str] = []
@@ -113,20 +121,31 @@ class LiveDeviceEngine:
     # -- construction ------------------------------------------------------
 
     def _bootstrap(self) -> None:
-        """Replay the hashgraph's existing DAG into device state."""
-        grid = grid_from_hashgraph(self.hg)
-        if grid.e and not (
+        """Build device state from the hashgraph's existing DAG.
+
+        Small base-state DAGs replay through the append pipeline (the
+        cheapest path and the one that exercises no store round lookups).
+        Anything else — post-reset states, DAGs past the write-back
+        window, rolled store windows — attaches FROM THE FRONTIER: the
+        same store-driven assembly a rebase performs, keeping only events
+        of rounds >= base plus undetermined ones. This is what lets a
+        restarted node with a deep sqlite history, or a node returning
+        from fast-sync, ride the live engine instead of being stuck on
+        the one-shot path forever."""
+        try:
+            grid = grid_from_hashgraph(self.hg)
+        except GridUnsupported:
+            # rolled store window: full history is unreachable, but the
+            # frontier assembly only touches recent rows
+            self._attach_from_frontier()
+            return
+        base_state = not grid.e or (
             (grid.ext_sp_round == -1).all() and (grid.ext_op_round == -1).all()
-        ):
-            raise GridUnsupported("live incremental engine needs a base-state DAG")
-        if grid.e > self.e_cap:
-            raise GridUnsupported(f"DAG ({grid.e}) exceeds device capacity")
-        if grid.e > self.e_win:
-            # the first call writes back EVERY bootstrapped row, which must
-            # fit the fetch window — fail before paying for the replay
-            raise GridUnsupported(
-                f"DAG ({grid.e}) exceeds the write-back window ({self.e_win})"
-            )
+        )
+        if not base_state or grid.e > self.e_win:
+            # capacity for the kept rows is enforced by _install_state
+            self._attach_from_frontier()
+            return
         self.hashes = list(grid.hashes)
         self.row_of = {h: r for r, h in enumerate(self.hashes)}
         if grid.e == 0:
@@ -143,6 +162,73 @@ class LiveDeviceEngine:
                 self.state, b, self.hg.super_majority, self.n,
                 e_win=self.e_win, r_win=min(32, self.r_cap),
             )
+
+    def _attach_base_round(self):
+        """(base, floor): floor = first fame-undecided round, base =
+        floor - 1 — the rebase invariant: fame voting for round j only
+        consults round j-1's witnesses, and an event no decided round
+        received can only be received at or after the first undecided
+        round."""
+        hg = self.hg
+        undecided = [p.index for p in hg.pending_rounds if not p.decided]
+        if undecided:
+            floor = min(undecided)
+        elif hg.last_consensus_round is not None:
+            floor = hg.last_consensus_round + 1
+        else:
+            floor = 0
+        return max(0, floor - 1), floor
+
+    def _attach_from_frontier(self) -> None:
+        """Fresh attach from the undecided frontier: walk each validator's
+        chain back from its head, keeping events of rounds >= base plus
+        undetermined ones — O(kept), no full-history enumeration, valid on
+        post-reset states (coordinates are reset-relative but internally
+        consistent) and rolled store windows."""
+        from ..common import StoreErr
+
+        hg = self.hg
+        base, floor = self._attach_base_round()
+
+        undet = set(hg.undetermined_events)
+        # stop the walk-back only below every undetermined event's round
+        stop = base
+        for h in undet:
+            try:
+                ev = hg.store.get_event(h)
+            except StoreErr as e:
+                raise GridUnsupported(f"attach: undetermined event lost ({e})")
+            if ev.round is not None:
+                stop = min(stop, ev.round)
+
+        kept_map = {}
+        for p in hg.participants.to_peer_slice():
+            try:
+                h, is_root = hg.store.last_event_from(p.pub_key_hex)
+            except StoreErr:
+                continue
+            if is_root:
+                continue
+            chain = []
+            while h:
+                try:
+                    ev = hg.store.get_event(h)
+                except StoreErr:
+                    break  # below the store window: everything older is final
+                if (
+                    ev.round is not None and ev.round < stop
+                    and h not in undet
+                ):
+                    break
+                chain.append((h, ev))
+                h = ev.self_parent()
+            for h2, ev2 in reversed(chain):
+                if (ev2.round is not None and ev2.round >= base) or h2 in undet:
+                    kept_map[h2] = ev2
+
+        # topological order (coordinates reference earlier rows only)
+        kept = sorted(kept_map.items(), key=lambda kv: kv[1].topological_index)
+        self._install_state(base, floor, kept)
 
     # -- rebasing ----------------------------------------------------------
 
@@ -161,6 +247,32 @@ class LiveDeviceEngine:
         Everything is assembled host-side from the store (coordinates are
         host-maintained and write-once) — one device upload, no replay.
         """
+        from ..common import StoreErr
+
+        hg = self.hg
+        base, floor = self._attach_base_round()
+        if base <= self.round_base:
+            raise GridUnsupported(
+                f"rebase cannot advance the round base (stuck at {base})"
+            )
+
+        undet = set(hg.undetermined_events)
+        kept: List[tuple] = []  # (hash, event)
+        try:
+            for h in self.hashes:
+                ev = hg.store.get_event(h)
+                if (ev.round is not None and ev.round >= base) or h in undet:
+                    kept.append((h, ev))
+        except StoreErr as e:
+            raise GridUnsupported(f"rebase: frontier event evicted ({e})")
+        self._install_state(base, floor, kept)
+        self.rebases += 1
+
+    def _install_state(self, base: int, floor: int, kept: List[tuple]) -> None:
+        """Assemble IncState host-side from (hash, event) rows of rounds
+        >= base plus undetermined ones, rounds stored base-relative — one
+        device upload, no replay. Shared by rebase() and the fresh
+        frontier attach."""
         import numpy as np
 
         from ..common import StoreErr
@@ -169,32 +281,12 @@ class LiveDeviceEngine:
 
         hg = self.hg
         n, e_cap, r_cap = self.n, self.e_cap, self.r_cap
-
-        undecided = [p.index for p in hg.pending_rounds if not p.decided]
-        if undecided:
-            floor = min(undecided)
-        elif hg.last_consensus_round is not None:
-            floor = hg.last_consensus_round + 1
-        else:
-            floor = 0
-        base = max(0, floor - 1)
-        if base <= self.round_base:
-            raise GridUnsupported(
-                f"rebase cannot advance the round base (stuck at {base})"
-            )
-
         undet = set(hg.undetermined_events)
-        kept: List[tuple] = []  # (hash, event)
+
         min_undet_round = floor
-        try:
-            for h in self.hashes:
-                ev = hg.store.get_event(h)
-                if (ev.round is not None and ev.round >= base) or h in undet:
-                    kept.append((h, ev))
-                    if h in undet and ev.round is not None:
-                        min_undet_round = min(min_undet_round, ev.round)
-        except StoreErr as e:
-            raise GridUnsupported(f"rebase: frontier event evicted ({e})")
+        for h, ev in kept:
+            if h in undet and ev.round is not None:
+                min_undet_round = min(min_undet_round, ev.round)
 
         # host-frozen rounds: a round below the frontier whose witness set
         # gained a late member has UNDEFINED fame forever on the host and
@@ -307,7 +399,6 @@ class LiveDeviceEngine:
         self.row_of = new_row_of
         self.hashes = new_hashes
         self.round_base = base
-        self.rebases += 1
 
     # -- advancing ---------------------------------------------------------
 
@@ -321,6 +412,9 @@ class LiveDeviceEngine:
         ``multi_step`` trains — one device program per up to 16 batches —
         padded with no-op batches to two fixed shapes (K=4/K=16) so the
         live path compiles at most three programs."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         if not self.pending:
             return []
         drained, self.pending = self.pending, []
@@ -347,6 +441,7 @@ class LiveDeviceEngine:
                     self.state, b, self.hg.super_majority, self.n,
                     e_win=self.e_win, r_win=min(32, self.r_cap),
                 )
+                self.dispatches += 1
         else:
             for i in range(0, len(built), 16):
                 group = built[i : i + 16]
@@ -356,6 +451,8 @@ class LiveDeviceEngine:
                     self.state, stack_batches(group),
                     self.hg.super_majority, self.n, e_win=self.e_win, r_win=min(32, self.r_cap),
                 )
+                self.dispatches += 1
+        self.dispatch_seconds += _time.perf_counter() - t0
         return new_rows
 
     def _empty_batch(self) -> Batch:
@@ -558,11 +655,16 @@ def run_consensus_live(hg) -> None:
 
     # ONE packed transfer of everything the write-back needs — per-array
     # fetches each pay a full host<->device round trip
+    import time as _time
+
     count = len(eng.hashes)
     lo = max(count - eng.e_win, 0)
+    t0 = _time.perf_counter()
     packed = jax.device_get(
         _pack_results(st, jnp_int32(lo), eng.e_win, eng.r_cap, eng.n)
     )
+    eng.fetch_seconds += _time.perf_counter() - t0
+    eng.consensus_calls += 1
     (rounds_w, lamport_w, witness_w, received_w, wtable, fame_decided,
      famous, stale, fame_lag, last_round_rel) = _unpack_results(
         packed, eng.e_win, eng.r_cap, eng.n)

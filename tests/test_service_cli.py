@@ -108,3 +108,34 @@ def test_config_file_merge_flags_win(tmp_path):
     _merge_config_file(args, argv)
     assert args.timeout == 5.0
     assert args.heartbeat == 2.0
+
+
+def test_service_debug_endpoints():
+    """/debug/stacks (thread dump) and /debug/profile (all-thread stack
+    sampler) — the profiling channel of the reference's
+    pprof-on-the-service-mux (reference: cmd/babble/main.go:4). The
+    profile must cover the NODE's threads, not just the HTTP handler: a
+    gossiping node's loops live in node.py, which must show up among the
+    sampled frames."""
+    import urllib.request
+
+    nodes, proxies = init_nodes(2)
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        run_nodes(nodes)
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+
+        with urllib.request.urlopen(base + "/debug/stacks", timeout=10) as r:
+            stacks = r.read().decode()
+        assert "thread" in stacks and "File" in stacks
+
+        with urllib.request.urlopen(
+            base + "/debug/profile?seconds=0.5", timeout=30
+        ) as r:
+            prof = r.read().decode()
+        assert "hottest frames" in prof
+        assert "node.py" in prof, "profile missed the node's own threads"
+    finally:
+        svc.shutdown()
+        shutdown_nodes(nodes)
