@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 from ..utils.netaddr import split_hostport
@@ -26,31 +27,55 @@ class JSONRPCError(Exception):
 # (net/tcp_transport.py DEFAULT_MAX_FRAME)
 DEFAULT_MAX_LINE = 64 << 20
 
+# client-side proactive reconnect age: safely below JSONRPCServer's default
+# idle_timeout (600 s), so a recycled-by-the-server connection is replaced
+# BEFORE a request is sent on it — never by resending after a failure,
+# which could double-execute a non-idempotent call (State.CommitBlock
+# applied twice silently diverges the app state: "hung up without
+# replying" does not guarantee "not executed")
+DEFAULT_IDLE_RECONNECT = 540.0
 
-def _read_bounded_line(rfile, max_line: int) -> Optional[bytes]:
-    """One newline-terminated line of payload <= max_line bytes, or None
-    when the stream closed / the line is over the limit (the caller hangs
-    up — never buffer an unbounded line). The single home of the boundary
+
+def _read_bounded_line(rfile, max_line: int):
+    """(line, oversized): one newline-terminated line of payload
+    <= max_line bytes. line is None when the stream closed or the line is
+    over the limit (the caller hangs up — never buffer an unbounded
+    line); oversized distinguishes the limit case so the server can send
+    an error reply before closing. The single home of the boundary
     arithmetic for both the client and the server."""
     line = rfile.readline(max_line + 2)
     if not line:
-        return None
-    if not line.endswith(b"\n") or len(line) > max_line + 1:
-        return None
-    return line
+        return None, False
+    if not line.endswith(b"\n"):
+        # either the limit truncated the read (oversized) or the stream
+        # ended mid-line (EOF — not the peer's size problem)
+        return None, len(line) > max_line
+    if len(line) > max_line + 1:
+        return None, True
+    return line, False
 
 
 class JSONRPCClient:
-    """One persistent connection, serialized calls."""
+    """One persistent connection, serialized calls.
+
+    No post-send retries: a request that failed mid-call may still have
+    executed server-side, so resending could double-apply it. The only
+    failure mode retries were for — the server recycling an idle
+    connection — is prevented up front by reconnecting when the
+    connection's age since last use exceeds ``idle_reconnect``.
+    """
 
     def __init__(self, addr: str, timeout: float = 5.0,
-                 max_line: Optional[int] = None):
+                 max_line: Optional[int] = None,
+                 idle_reconnect: float = DEFAULT_IDLE_RECONNECT):
         self.addr = addr
         self.timeout = timeout
         self.max_line = DEFAULT_MAX_LINE if max_line is None else max_line
+        self.idle_reconnect = idle_reconnect
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._next_id = 0
+        self._last_used = 0.0
         self._lock = threading.Lock()
 
     def _connect(self) -> None:
@@ -61,52 +86,54 @@ class JSONRPCClient:
 
     def call(self, method: str, param: Any) -> Any:
         with self._lock:
-            # one transparent retry: a server that recycled our idle
-            # connection (JSONRPCServer.idle_timeout) surfaces as a dead
-            # socket on the next call — reconnect once rather than drop
-            # the request
-            for attempt in (0, 1):
-                if self._sock is None:
-                    try:
-                        self._connect()
-                    except OSError as exc:
-                        self.close_locked()
-                        raise JSONRPCError(
-                            f"connect to {self.addr}: {exc}"
-                        ) from exc
-                self._next_id += 1
-                msg = json.dumps(
-                    {"method": method, "params": [param], "id": self._next_id}
-                ).encode() + b"\n"
+            # proactive recycle of idle connections (see class docstring)
+            if (
+                self._sock is not None
+                and time.monotonic() - self._last_used >= self.idle_reconnect
+            ):
+                self.close_locked()
+            if self._sock is None:
                 try:
-                    self._sock.sendall(msg)
-                    line = self._rfile.readline(self.max_line + 2)
-                    if not line:
-                        raise ConnectionError("connection closed")
-                except (OSError, AttributeError) as exc:
+                    self._connect()
+                except OSError as exc:
                     self.close_locked()
-                    # retry ONLY the recycled-connection signature: the
-                    # server hung up without replying (ConnectionError).
-                    # A timeout means the request may still be executing —
-                    # resending would double-execute a non-idempotent call
-                    # (TimeoutError subclasses OSError, not
-                    # ConnectionError, so it lands in the raise)
-                    if attempt == 0 and isinstance(exc, ConnectionError):
-                        continue
                     raise JSONRPCError(
-                        f"rpc {method} to {self.addr}: {exc}"
+                        f"connect to {self.addr}: {exc}"
                     ) from exc
-                if not line.endswith(b"\n") or len(line) > self.max_line + 1:
-                    # bounded read: a server streaming an endless response
-                    # line must not grow our memory without limit
-                    self.close_locked()
-                    raise JSONRPCError(
-                        f"rpc {method}: response line too large"
-                    )
-                resp = json.loads(line)
-                if resp.get("error"):
-                    raise JSONRPCError(str(resp["error"]))
-                return resp.get("result")
+            self._next_id += 1
+            msg = json.dumps(
+                {"method": method, "params": [param], "id": self._next_id}
+            ).encode() + b"\n"
+            if len(msg) > self.max_line + 1:
+                # the server would refuse this line; failing here avoids
+                # shipping tens of MB just to be hung up on
+                raise JSONRPCError(
+                    f"rpc {method}: request line too large "
+                    f"({len(msg)} > {self.max_line})"
+                )
+            try:
+                self._sock.sendall(msg)
+                self._last_used = time.monotonic()
+                line = self._rfile.readline(self.max_line + 2)
+                if not line:
+                    raise ConnectionError("connection closed")
+            except (OSError, AttributeError) as exc:
+                self.close_locked()
+                raise JSONRPCError(
+                    f"rpc {method} to {self.addr}: {exc}"
+                ) from exc
+            self._last_used = time.monotonic()
+            if not line.endswith(b"\n") or len(line) > self.max_line + 1:
+                # bounded read: a server streaming an endless response
+                # line must not grow our memory without limit
+                self.close_locked()
+                raise JSONRPCError(
+                    f"rpc {method}: response line too large"
+                )
+            resp = json.loads(line)
+            if resp.get("error"):
+                raise JSONRPCError(str(resp["error"]))
+            return resp.get("result")
 
     def close_locked(self) -> None:
         if self._sock is not None:
@@ -179,15 +206,33 @@ class JSONRPCServer:
             sock.settimeout(self.idle_timeout)
             rfile = sock.makefile("rb")
             while not self._shutdown.is_set():
-                line = _read_bounded_line(rfile, self.max_line)
+                line, oversized = _read_bounded_line(rfile, self.max_line)
                 if line is None:
-                    # closed, oversized, or unterminated: hang up
+                    if oversized:
+                        # tell the peer WHY before hanging up (no id was
+                        # parseable — the line was never buffered); the
+                        # client surfaces this instead of a bare
+                        # connection reset it cannot distinguish from a
+                        # recycled connection
+                        self._reply_error(
+                            sock, None,
+                            f"request line exceeds {self.max_line} bytes",
+                        )
                     return
-                req = json.loads(line)
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    self._reply_error(sock, None, "malformed JSON request")
+                    return
                 if not isinstance(req, dict) or not isinstance(
                     req.get("method", ""), str
                 ):
-                    # malformed-but-valid JSON: hang up, don't guess
+                    # malformed-but-valid JSON: error out, don't guess
+                    self._reply_error(
+                        sock,
+                        req.get("id") if isinstance(req, dict) else None,
+                        "malformed request object",
+                    )
                     return
                 rid = req.get("id")
                 handler = self._handlers.get(req.get("method", ""))
@@ -216,6 +261,18 @@ class JSONRPCServer:
                 sock.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def _reply_error(sock: socket.socket, rid, msg: str) -> None:
+        """Best-effort error response before a hang-up (the connection is
+        unusable either way; the reply just makes the cause visible)."""
+        try:
+            sock.sendall(
+                json.dumps({"id": rid, "result": None, "error": msg}).encode()
+                + b"\n"
+            )
+        except OSError:
+            pass
 
     def close(self) -> None:
         self._shutdown.set()

@@ -86,10 +86,47 @@ def test_tcp_oversized_frame_rejected():
         server.close()
 
 
+def read_error_then_close(sock, what):
+    """The server's contract on bad input: ONE JSON error line (so the
+    peer can tell 'refused' from 'connection recycled'), then close. A
+    timeout means it silently buffered/kept the connection — the exact
+    regression this helper exists to catch."""
+    import json
+
+    buf = b""
+    try:
+        while b"\n" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+    except TimeoutError:
+        raise AssertionError(f"server kept the {what} connection open") from None
+    except ConnectionError:
+        pass
+    if buf:
+        line, _, rest = buf.partition(b"\n")
+        resp = json.loads(line)
+        assert resp.get("error"), f"expected an error reply, got {resp!r}"
+        assert rest == b""
+    # after the (optional) error line the connection must be CLOSED
+    try:
+        tail = sock.recv(1)
+    except TimeoutError:
+        raise AssertionError(f"server kept the {what} connection open") from None
+    except ConnectionError:
+        tail = b""
+    assert tail == b"", "server should close the connection"
+    return buf
+
+
 def test_jsonrpc_oversized_line_rejected():
-    """A request line beyond max_line must get the connection dropped
-    before buffering, and the server must keep serving other clients."""
-    from babble_tpu.proxy.jsonrpc import JSONRPCClient, JSONRPCServer
+    """A request line beyond max_line must be refused without buffering:
+    the server answers with a JSON-RPC error (the line's id is unknowable,
+    so id null) and closes, and keeps serving other clients."""
+    from babble_tpu.proxy.jsonrpc import (
+        JSONRPCClient, JSONRPCError, JSONRPCServer,
+    )
 
     server = JSONRPCServer("127.0.0.1:0", max_line=4096)
     server.register("Echo.Ping", lambda x: x)
@@ -100,42 +137,32 @@ def test_jsonrpc_oversized_line_rejected():
         bad.settimeout(2)
         try:
             bad.sendall(b"x" * 8192)  # no newline, twice the limit
-            # the server must CLOSE (recv -> b"" or a reset). A timeout
-            # here means it silently buffered the oversized line — the
-            # exact regression this test exists to catch — so TimeoutError
-            # must FAIL the test, not be swallowed (it subclasses OSError).
-            try:
-                data = bad.recv(1)
-            except TimeoutError:
-                raise AssertionError(
-                    "server kept the oversized connection open"
-                ) from None
-            except ConnectionError:
-                data = b""
-            assert data == b"", "server should close the connection"
+            reply = read_error_then_close(bad, "oversized")
+            assert b"exceeds" in reply
         finally:
             bad.close()
 
-        # valid-JSON-but-non-object lines must hang up cleanly too
+        # valid-JSON-but-non-object lines get an error + hang-up too
         bad2 = socket.create_connection((host, int(port)), timeout=2)
         bad2.settimeout(2)
         try:
             bad2.sendall(b"5\n")
-            try:
-                data = bad2.recv(1)
-            except TimeoutError:
-                raise AssertionError(
-                    "server kept the malformed connection open"
-                ) from None
-            except ConnectionError:
-                data = b""
-            assert data == b""
+            read_error_then_close(bad2, "malformed")
         finally:
             bad2.close()
 
-        client = JSONRPCClient(server.addr)
+        client = JSONRPCClient(server.addr, max_line=4096)
         try:
             assert client.call("Echo.Ping", "ok") == "ok"
+            # a client-side oversized request fails fast WITHOUT being
+            # sent (no wasted transfer, no ambiguous half-executed call)
+            try:
+                client.call("Echo.Ping", "y" * 8192)
+                raise AssertionError("oversized request was not refused")
+            except JSONRPCError as e:
+                assert "too large" in str(e)
+            # and the connection remains usable
+            assert client.call("Echo.Ping", "ok2") == "ok2"
         finally:
             client.close()
     finally:
