@@ -83,6 +83,10 @@ class Hashgraph:
         # lets tests prove the adversarial branch was exercised
         self.coin_rounds = 0
         self.coin_flips = 0
+        # deepest fame decision (j - round_index at the deciding vote):
+        # 2 = every witness decided on the first ballot; >= 3 proves
+        # contested fame (split votes forced extra voting rounds)
+        self.max_fame_depth = 0
         self.pending_loaded_events = 0
         self.topological_index = 0
 
@@ -571,6 +575,9 @@ class Hashgraph:
                                     round_info.set_fame(x, v)
                                     votes[(y, x)] = v
                                     decided = True
+                                    self.max_fame_depth = max(
+                                        self.max_fame_depth, diff
+                                    )
                                     break
                                 votes[(y, x)] = v
                             else:
