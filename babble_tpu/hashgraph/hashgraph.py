@@ -89,6 +89,9 @@ class Hashgraph:
         self.max_fame_depth = 0
         self.pending_loaded_events = 0
         self.topological_index = 0
+        # the frame a reset() was applied from, pinned beyond the store's
+        # LRU so the anchor it backs stays servable (see reset/get_frame)
+        self._reset_frame: Optional[Frame] = None
 
         # peer-position lookups shared with the device grids
         self._pos_by_pubkey: Dict[str, int] = {
@@ -692,6 +695,11 @@ class Hashgraph:
         except StoreErr as e:
             if not is_store_err(e, StoreErrType.KEY_NOT_FOUND):
                 raise
+        rf = getattr(self, "_reset_frame", None)
+        if rf is not None and rf.round == round_received:
+            # the pinned post-reset frame (see reset()): evicted from the
+            # store's LRU but still the only buildable copy of its round
+            return rf
 
         round_info = self.store.get_round(round_received)
         events = [self.store.get_event(eh) for eh in round_info.consensus_events()]
@@ -817,6 +825,17 @@ class Hashgraph:
         root_map = {participants[pos].pub_key_hex: root for pos, root in enumerate(frame.roots)}
         self.store.reset(root_map)
         self.store.set_block(block)
+        # keep the received frame servable: it IS the frame at the anchor's
+        # round_received, already validated against the block's signed
+        # FrameHash. Without it, a fresh-synced node that becomes an anchor
+        # holder cannot rebuild the frame (the round's consensus bookkeeping
+        # predates the reset) and every FastForwardRequest it serves fails
+        # with a missing-round error — observed livelocking a cluster whose
+        # only Babbling node was a fresh joiner. Pinned on the hashgraph as
+        # well: the store's frame cache is an evicting LRU, and a stalled
+        # anchor must stay servable past cache_size newer rounds.
+        self.store.set_frame(frame)
+        self._reset_frame = frame
         self._set_last_consensus_round(block.round_received())
 
         for ev in frame.events:
@@ -920,6 +939,33 @@ class Hashgraph:
                     proof_blocks[i] = self.store.get_block(i)
                 except StoreErr:
                     continue
+
+        # Truncate to the provable prefix. The joiner refuses any replayed
+        # block below its 2-round trust window without >1/3 valid
+        # signatures (verify_section) — and blocks committed right before
+        # a validator die-off may NEVER gather them (the signers are
+        # gone). Shipping those frames would make every fast-forward from
+        # this donor fail permanently. Instead, ship frames only up to one
+        # round past the first unprovable block — inside the joiner's
+        # trust window — and let the joiner recompute the rest from the
+        # shipped events through its own consensus (same DAG, same
+        # decisions; the section docstring's "truncation only delays the
+        # joiner" promise, made real).
+        if anchor_block_index >= 0:
+            next_index = anchor_block_index + 1
+            cut_round = None
+            for f in frames:
+                if not f.events:
+                    continue
+                valid = self._block_proof_count(
+                    f, proof_blocks.get(next_index), next_index
+                )
+                if valid <= self.trust_count:
+                    cut_round = f.round + 1
+                    break
+                next_index += 1
+            if cut_round is not None:
+                frames = [f for f in frames if f.round <= cut_round]
         base_meta = [
             FrozenRef(
                 hash=ev.hex(),
@@ -1000,15 +1046,9 @@ class Hashgraph:
         for frame in section.frames:
             if not frame.events:
                 continue
-            proof = section.proof_blocks.get(next_index)
-            valid = 0
-            if (
-                proof is not None
-                and proof.index() == next_index
-                and proof.round_received() == frame.round
-                and proof.frame_hash() == frame.hash()
-            ):
-                valid = self.valid_signature_count(proof)
+            valid = self._block_proof_count(
+                frame, section.proof_blocks.get(next_index), next_index
+            )
             if valid <= self.trust_count and frame.round <= sig_lag_floor:
                 raise ValueError(
                     f"fast-sync section: replayed block {next_index} "
@@ -1176,15 +1216,37 @@ class Hashgraph:
         )
         return event
 
-    def valid_signature_count(self, block: Block) -> int:
+    def valid_signature_count(self, block: Block, limit: int = None) -> int:
         """Signatures that are both cryptographically valid AND from a
         member of the validator set — a signature from any other key proves
-        nothing (process_sig_pool applies the same membership filter)."""
-        return sum(
-            1
-            for s in block.get_signatures()
-            if s.validator_hex() in self.participants.by_pub_key and block.verify(s)
-        )
+        nothing (process_sig_pool applies the same membership filter).
+        `limit` stops the (ECDSA-verify-per-signature) count early once
+        reached — threshold checks only need trust_count + 1, not all N."""
+        count = 0
+        for s in block.get_signatures():
+            if s.validator_hex() in self.participants.by_pub_key and block.verify(s):
+                count += 1
+                if limit is not None and count >= limit:
+                    return count
+        return count
+
+    def _block_proof_count(self, frame: Frame, proof: Optional[Block],
+                           expected_index: int) -> int:
+        """Valid-signature count of `proof` iff it matches the block this
+        frame replays (identity triple: index, round_received, frame hash)
+        — the ONE pairing rule shared by the donor's provable-prefix
+        truncation (get_section) and the joiner's check (verify_section);
+        the two must never diverge or donors ship sections their joiners
+        deterministically reject. Capped at trust_count + 1 (the threshold
+        both callers compare against)."""
+        if (
+            proof is None
+            or proof.index() != expected_index
+            or proof.round_received() != frame.round
+            or proof.frame_hash() != frame.hash()
+        ):
+            return 0
+        return self.valid_signature_count(proof, limit=self.trust_count + 1)
 
     def check_block(self, block: Block) -> None:
         """Valid iff strictly more than 1/3 of participants signed."""

@@ -207,7 +207,16 @@ class Node(NodeStateMachine):
 
     def _process_rpc(self, rpc: RPC) -> None:
         state = self.get_state()
-        if state != NodeState.BABBLING:
+        if state != NodeState.BABBLING and not (
+            state == NodeState.CATCHING_UP
+            and isinstance(rpc.command, FastForwardRequest)
+        ):
+            # Deliberate deviation from the reference (node.go:205-216),
+            # which discards every RPC outside Babbling: FastForwardRequest
+            # is served from STORED state (anchor block + frame + section)
+            # and needs no live consensus, and refusing it while CatchingUp
+            # livelocks a cluster where several nodes flip together — each
+            # refuses the others with "not ready" and nobody can exit.
             self.logger.debug("Discarding RPC Request in state %s", state)
             # error-only response: both transports short-circuit on the
             # error before deserializing a body, so no command ever gets a
@@ -351,6 +360,35 @@ class Node(NodeStateMachine):
             resp = self.trans.fast_forward(
                 peer.net_addr, FastForwardRequest(from_id=self.id)
             )
+            # Rewind guards (deliberately beyond the reference,
+            # node.go:494-541, which assumes every flip to CatchingUp is
+            # genuine). Applying a reset that rewinds OUR OWN chain below
+            # events peers have already seen makes our next events re-use
+            # indexes — peers then resolve wire parents to the old events
+            # and reject our whole diff with invalid-signature/fork
+            # errors, permanently. A node that flipped on a transient
+            # sync burst is exactly the node with fresh broadcast events,
+            # so it bounces back to Babbling here; a node genuinely
+            # behind in EVENTS (even at an equal block index) has a stale
+            # own chain and applies safely, gaining the section's events.
+            if resp.block.index() < self.core.get_last_block_index():
+                self.logger.debug(
+                    "fast_forward: anchor %d behind our block %d — resuming",
+                    resp.block.index(), self.core.get_last_block_index(),
+                )
+                self.set_state(NodeState.BABBLING)
+                self.set_starting(True)
+                return
+            my_frame_idx = self._own_index_in(resp.frame, resp.section)
+            if self.core.seq > my_frame_idx:
+                self.logger.debug(
+                    "fast_forward: reset would rewind own chain "
+                    "(seq %d > frame %d) — not actually behind, resuming",
+                    self.core.seq, my_frame_idx,
+                )
+                self.set_state(NodeState.BABBLING)
+                self.set_starting(True)
+                return
             # validate first (no state mutated), THEN restore the app, THEN
             # apply: the restore must precede the apply because the section
             # replays blocks above the anchor through the commit channel
@@ -396,6 +434,28 @@ class Node(NodeStateMachine):
     # ------------------------------------------------------------------
     # sync / commit / transactions
     # ------------------------------------------------------------------
+
+    def _own_index_in(self, frame, section) -> int:
+        """Highest index of OUR OWN events present in incoming fast-forward
+        materials (frame root, frame events, section events/frames) — the
+        index our chain would continue from after applying the reset. If
+        our current seq exceeds it, applying would rewind our broadcast
+        chain (see the guard in fast_forward)."""
+        me = self.core.hex_id()
+        idx = -1
+        for i, p in enumerate(self.core.participants.to_peer_slice()):
+            if p.pub_key_hex == me:
+                idx = frame.roots[i].self_parent.index
+                break
+        pools = [frame.events]
+        if section is not None:
+            pools.append(section.events)
+            pools.extend(f.events for f in section.frames)
+        for pool in pools:
+            for ev in pool:
+                if ev.creator() == me and ev.index() > idx:
+                    idx = ev.index()
+        return idx
 
     def sync(self, events) -> None:
         """Insert events then run the 5-pass pipeline. Caller must hold

@@ -254,6 +254,103 @@ def test_core_fast_forward_then_keep_syncing():
         )
 
 
+def test_fast_synced_core_serves_its_own_anchor():
+    """Regression: a core that joined via fast-forward must be able to
+    SERVE the anchor it now holds. The received frame's round predates the
+    reset, so the joiner cannot rebuild it from round bookkeeping — reset
+    must keep the validated frame itself in the frame cache, or every
+    FastForwardRequest the joiner serves dies with a missing-round error
+    (observed livelocking a cluster whose only Babbling node was a fresh
+    joiner: the CatchingUp peers refuse each other, the joiner errors)."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 2:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 600
+
+    blk = cores[0].hg.store.get_block(1)
+    for c in cores[:3]:
+        blk.set_signature(blk.sign(c.key))
+    cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+    section = cores[0].hg.get_section(frame.round)
+
+    joiner = Core(
+        3, cores[3].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    joiner.fast_forward(cores[0].hex_id(), block, frame, section)
+
+    # the joiner holds the signed anchor block; it must serve it with the
+    # exact frame it validated (chained fast-sync donor capability)
+    joiner.hg.anchor_block = block.index()
+    served_block, served_frame = joiner.get_anchor_block_with_frame()
+    assert served_block.index() == block.index()
+    assert served_frame.hash() == frame.hash()
+
+    # ... and a second-generation joiner fast-forwards off it
+    joiner2 = Core(
+        2, cores[2].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    section2 = joiner.hg.get_section(served_frame.round)
+    joiner2.fast_forward(joiner.hex_id(), served_block, served_frame, section2)
+    assert joiner2.get_last_block_index() >= block.index()
+
+
+def test_section_truncates_at_unprovable_block():
+    """A donor whose stored chain contains a block that can no longer
+    gather >1/3 signatures (its signers died right after commit) must
+    TRUNCATE its section at that block instead of shipping frames the
+    joiner is bound to reject — otherwise every fast-forward from this
+    donor fails forever and a die-off survivor can never serve a joiner.
+    The joiner syncs the provable prefix and recomputes the rest from
+    the shipped events."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 5:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 1500, "3-core playbook failed to make blocks"
+
+    for bi in range(1, cores[0].get_last_block_index() + 1):
+        blk = cores[0].hg.store.get_block(bi)
+        for c in cores[:3]:
+            blk.set_signature(blk.sign(c.key))
+        cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+
+    # block 3 permanently under-signed: keep only the donor's own signature
+    b3 = cores[0].hg.store.get_block(3)
+    b3.signatures = {k: v for k, v in list(b3.signatures.items())[:1]}
+    cores[0].hg.store.set_block(b3)
+
+    section = cores[0].hg.get_section(frame.round, block.index())
+    # the donor must not ship provable-prefix-violating frames: the frame
+    # producing block 3 sits deeper than the joiner's 2-round trust window
+    # in the untruncated section, so the section must stop early
+    b3_round = cores[0].hg.store.get_block(3).round_received()
+    assert max(f.round for f in section.frames) <= b3_round + 1
+
+    joiner = Core(
+        3, cores[3].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    joiner.fast_forward(cores[0].hex_id(), block, frame, section)
+    assert joiner.get_last_block_index() >= block.index()
+    # the provable prefix replayed byte-identically
+    for bi in range(block.index() + 1, min(3, joiner.get_last_block_index() + 1)):
+        assert (
+            cores[0].hg.store.get_block(bi).body.marshal()
+            == joiner.hg.store.get_block(bi).body.marshal()
+        )
+
+
 def test_verify_section_rejects_forged_continuation():
     """A single malicious donor must not be able to feed a joiner a
     fabricated consensus continuation: every replayed block outside the

@@ -118,6 +118,107 @@ def test_sync_limit_response():
         shutdown_nodes(nodes)
 
 
+def test_catching_up_node_serves_fast_forward():
+    """A node in CatchingUp must still answer FastForwardRequest from its
+    STORED anchor (deliberate deviation from the reference, which discards
+    all RPCs outside Babbling): when several nodes flip to CatchingUp
+    together, mutual "not ready" refusals would otherwise livelock the
+    cluster — nobody can fast-forward, nobody exits."""
+    from babble_tpu.net import FastForwardRequest
+
+    conf = make_config()
+    nodes, proxies, *_ = build_cluster(4, conf)
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
+        donor = nodes[0]
+        # wait for an anchor to accumulate signatures
+        deadline = time.monotonic() + 60
+        while donor.core.hg.anchor_block is None and time.monotonic() < deadline:
+            bombard_and_wait(
+                nodes, proxies,
+                target_block=donor.core.get_last_block_index() + 1,
+                timeout_s=120,
+            )
+        assert donor.core.hg.anchor_block is not None
+
+        # flip the donor to CatchingUp and request a fast-forward from it.
+        # Cut the donor's OUTBOUND links first: its own run loop would
+        # otherwise fast-forward against a peer, hit the not-actually-
+        # behind guard, and bounce back to Babbling mid-assertion —
+        # stranded outbound keeps it deterministically in CatchingUp
+        # (inbound delivery rides the requesters' own transports).
+        donor.trans.disconnect_all()
+        donor.set_state(NodeState.CATCHING_UP)
+        resp = nodes[1].trans.fast_forward(
+            donor.local_addr, FastForwardRequest(from_id=nodes[1].id)
+        )
+        assert resp.block is not None and resp.frame is not None
+        # ordinary sync requests stay refused outside Babbling
+        try:
+            nodes[1].trans.sync(
+                donor.local_addr,
+                SyncRequest(from_id=nodes[1].id,
+                            known=nodes[1].core.known_events()),
+            )
+            raise AssertionError("sync served in CatchingUp")
+        except Exception as e:  # noqa: BLE001
+            assert "not ready" in str(e)
+        donor.set_state(NodeState.BABBLING)
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_spurious_catching_up_bounces_back():
+    """A node that flips to CatchingUp while actually current must NOT
+    apply a fast-forward: every donor anchor is at or below its own last
+    block, and applying would rewind its own chain — its next events
+    would re-use indexes peers have already seen, and the whole cluster
+    rejects its diffs with invalid-signature/fork errors forever. The
+    node must bounce straight back to Babbling with its chain intact."""
+    conf = make_config()
+    nodes, proxies, *_ = build_cluster(4, conf)
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=2, timeout_s=180)
+        donor = nodes[0]
+        deadline = time.monotonic() + 60
+        while donor.core.hg.anchor_block is None and time.monotonic() < deadline:
+            bombard_and_wait(
+                nodes, proxies,
+                target_block=donor.core.get_last_block_index() + 1,
+                timeout_s=120,
+            )
+        assert donor.core.hg.anchor_block is not None
+
+        victim = nodes[1]
+        blocks_before = victim.core.get_last_block_index()
+        head_before = victim.core.head
+        seq_before = victim.core.seq
+        victim.set_state(NodeState.CATCHING_UP)
+        # drive the catch-up attempts directly (the run loop does the
+        # same); donors' anchors are all <= the victim's last block, so
+        # the guard must resume Babbling without ever resetting
+        deadline = time.monotonic() + 60
+        while (
+            victim.get_state() == NodeState.CATCHING_UP
+            and time.monotonic() < deadline
+        ):
+            victim.fast_forward()
+        assert victim.get_state() == NodeState.BABBLING
+        assert victim.core.get_last_block_index() >= blocks_before
+        # the node may legitimately create NEW events once resumed; what
+        # it must never do is rewind: its index counter stays monotone and
+        # the event it had at seq_before is still the same one
+        assert victim.core.seq >= seq_before, "own chain was rewound"
+        ev = victim.core.hg.store.participant_event(
+            victim.core.hex_id(), seq_before
+        )
+        assert ev == head_before, "own chain was forked by the reset"
+    finally:
+        shutdown_nodes(nodes)
+
+
 def test_catch_up():
     """Start 3 of 4 nodes, run ahead beyond sync-limit, then start the 4th:
     it must flip to CatchingUp, fast-forward from a peer's anchor block and
