@@ -255,11 +255,23 @@ def synthetic_grid(
     seed: int = 0,
     zipf_a: float = 0.0,
     record_fd_updates: bool = False,
+    byzantine_frac: float = 0.0,
+    withhold_span: int = 24,
 ) -> DagGrid:
     """Generate a random gossip DAG the way gossip produces one: each new
     event is a sync — creator c extends its own chain with an other-parent
     drawn from another validator's head (Zipf-skewed fan-out when zipf_a>0,
     reference scenario: BASELINE.json config #3).
+
+    byzantine_frac > 0 gives the first floor(frac*n) validators an
+    adversarial withhold/flush lifecycle (BASELINE.json config #4's
+    "adversarial 1/3-byzantine event graph"): while withholding, a
+    validator's new events are invisible to partner choice (nobody
+    references its head, its own other-parents go stale), then the hidden
+    chain is revealed all at once by an honest event referencing it.
+    Withholding is staggered at n//8 concurrent validators so the visible
+    set keeps a supermajority (the structure mirror of
+    tests/test_byzantine_scale.py's host-path generator).
 
     Coordinates (lastAncestors/firstDescendants) are built exactly as the
     host insert path does (reference: src/hashgraph/hashgraph.go:439-544).
@@ -290,17 +302,43 @@ def synthetic_grid(
     else:
         weights = np.full(n, 1.0 / n)
 
+    n_byz = int(byzantine_frac * n)
+    visible_head = np.full(n, -1, dtype=np.int64)
+    withholding = np.zeros(n, dtype=bool)
+    hidden_since = np.zeros(n, dtype=np.int64)
+
     # first event per validator, then gossip syncs
     for i in range(e_count):
+        forced_op = None
         if i < n:
             c = i
             op_row = -1
         else:
             c = int(rng.integers(n))
-            partner = int(rng.choice(n, p=weights))
-            while partner == c:
+            if c < n_byz:
+                if (
+                    not withholding[c]
+                    and int(withholding.sum()) < max(n // 8, 1)
+                    and rng.random() < 1.0 / withhold_span
+                ):
+                    withholding[c] = True
+                    hidden_since[c] = next_index[c]
+                elif (
+                    withholding[c]
+                    and next_index[c] - hidden_since[c] >= withhold_span
+                ):
+                    # flush: an honest event reveals the hidden chain
+                    withholding[c] = False
+                    visible_head[c] = head[c]
+                    forced_op = int(head[c])
+                    c = n_byz + int(rng.integers(n - n_byz)) if n_byz < n else c
+            if forced_op is not None:
+                op_row = forced_op
+            else:
                 partner = int(rng.choice(n, p=weights))
-            op_row = int(head[partner])
+                while partner == c or visible_head[partner] < 0:
+                    partner = int(rng.choice(n, p=weights))
+                op_row = int(visible_head[partner])
         creator[i] = c
         index[i] = next_index[c]
         self_parent[i] = head[c]
@@ -336,6 +374,8 @@ def synthetic_grid(
                     break
 
         head[c] = i
+        if not withholding[c]:
+            visible_head[c] = i
         next_index[c] += 1
 
     coin = rng.integers(0, 2, size=e_count).astype(bool)

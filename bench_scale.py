@@ -1,13 +1,18 @@
-"""Scale-point benchmark: the round-frontier pipeline at BASELINE config #5's
-validator count (1024 validators; reference scale axis: BASELINE.json
-`configs[4]` — "streaming rounds with on-device DAG frontier").
+"""Scale-point benchmark: the round-frontier pipeline at BASELINE's large
+validator counts.
+
+Two configs, selected by SCALE_CONFIG (default 5):
+- SCALE_CONFIG=5 — 1024 validators, Zipf gossip (BASELINE.json configs[4],
+  "streaming rounds with on-device DAG frontier").
+- SCALE_CONFIG=4 — 256 validators with an adversarial 1/3-byzantine graph
+  (withhold/flush cycles, Zipf fan-out; BASELINE.json configs[3]).
 
 Complements bench.py (the 64-validator metric of record): same timed path,
-same in-run bit-exactness gate vs the level-scan engine, at the largest
-configured validator scale. Run on the real chip for the recorded scale
-point; the multi-chip analog of this shape is exercised by the CPU-mesh
-differential (tests/test_multichip.py::test_frontier_sharded_n256 and the
-8-way run recorded in BASELINE.md).
+same in-run bit-exactness gate vs the level-scan engine, at the configured
+validator scale. Run on the real chip for the recorded scale point; the
+multi-chip analog of this shape is exercised by the CPU-mesh differential
+(tests/test_multichip.py::test_frontier_sharded_n256 and the 8-way run
+recorded in BASELINE.md).
 
 Prints one JSON line like bench.py.
 """
@@ -19,15 +24,26 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N_VALIDATORS = 1024
-N_EVENTS = 32768
-SEED = 7
-ZIPF = 1.02
+SCALE_CONFIG = int(os.environ.get("SCALE_CONFIG", "5"))
+if SCALE_CONFIG == 4:
+    N_VALIDATORS = 256
+    N_EVENTS = 16384
+    SEED = 11
+    ZIPF = 1.05
+    BYZ_FRAC = 1.0 / 3.0
+    LABEL = "BASELINE config #4, 1/3-byzantine withhold/flush graph"
+else:
+    N_VALIDATORS = 1024
+    N_EVENTS = 32768
+    SEED = 7
+    ZIPF = 1.02
+    BYZ_FRAC = 0.0
+    LABEL = "BASELINE config #5 scale"
 
 CACHE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "bench_cache",
-    f"grid_{N_VALIDATORS}x{N_EVENTS}_seed{SEED}.npz",
+    f"grid_{N_VALIDATORS}x{N_EVENTS}_seed{SEED}_b{int(BYZ_FRAC * 100)}.npz",
 )
 
 
@@ -65,7 +81,10 @@ def load_grid():
             num_levels=num_levels,
         )
 
-    grid = synthetic_grid(N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=ZIPF)
+    grid = synthetic_grid(
+        N_VALIDATORS, N_EVENTS, seed=SEED, zipf_a=ZIPF,
+        byzantine_frac=BYZ_FRAC,
+    )
     os.makedirs(os.path.dirname(CACHE), exist_ok=True)
     np.savez_compressed(
         CACHE,
@@ -148,7 +167,7 @@ def main():
             {
                 "metric": (
                     "events ordered/sec, frontier pipeline, "
-                    f"{N_VALIDATORS} validators (BASELINE config #5 scale), "
+                    f"{N_VALIDATORS} validators ({LABEL}), "
                     f"{N_EVENTS} events, platform={jax.devices()[0].platform}"
                 ),
                 "value": round(events_per_sec, 1),

@@ -29,16 +29,21 @@ def run_frontier(grid, r_cap):
     return ref, res
 
 
-@pytest.mark.parametrize("n,e,seed,zipf", [
-    (4, 64, 1, 0.0),
-    (8, 256, 2, 0.0),
-    (8, 512, 3, 1.1),
-    (16, 1024, 4, 1.1),
-    (8, 300, 7, 2.0),  # heavy skew: deep chains, frequent round jumps
-    (32, 768, 9, 1.1),  # wider validator set (supermajority = 22)
+@pytest.mark.parametrize("n,e,seed,zipf,byz", [
+    (4, 64, 1, 0.0, 0.0),
+    (8, 256, 2, 0.0, 0.0),
+    (8, 512, 3, 1.1, 0.0),
+    (16, 1024, 4, 1.1, 0.0),
+    (8, 300, 7, 2.0, 0.0),  # heavy skew: deep chains, frequent round jumps
+    (32, 768, 9, 1.1, 0.0),  # wider validator set (supermajority = 22)
+    # adversarial withhold/flush structure (BASELINE config #4's graph
+    # shape, bench_scale.py SCALE_CONFIG=4): stale other-parents and
+    # bursty chain reveals
+    (32, 1024, 11, 1.05, 1.0 / 3.0),
+    (64, 2048, 13, 1.05, 1.0 / 3.0),
 ])
-def test_frontier_matches_scan(n, e, seed, zipf):
-    grid = synthetic_grid(n, e, seed=seed, zipf_a=zipf)
+def test_frontier_matches_scan(n, e, seed, zipf, byz):
+    grid = synthetic_grid(n, e, seed=seed, zipf_a=zipf, byzantine_frac=byz)
     r_cap = 64
     ref, res = run_frontier(grid, r_cap)
 
