@@ -281,13 +281,29 @@ class Node(NodeStateMachine):
             with self.core_lock:
                 # anchor + live section must come from one consistent snapshot
                 block, frame = self.core.get_anchor_block_with_frame()
-                section = self.core.hg.get_section(frame.round, block.index())
+                try:
+                    section = self.core.hg.get_section(frame.round, block.index())
+                except Exception as se:  # noqa: BLE001 — degraded serve:
+                    # the live section walks history above the anchor; on a
+                    # long-lived donor with a lagging anchor that history
+                    # can be LRU-evicted. Serving anchor+frame+snapshot
+                    # WITHOUT the section still lets the joiner reset and
+                    # catch the rest through ordinary gossip — strictly
+                    # better than refusing every joiner forever.
+                    self.logger.warning(
+                        "FastForwardRequest: serving without live section "
+                        "(%s)", se, exc_info=True,
+                    )
+                    section = None
             resp.block = block
             resp.frame = frame
             resp.section = section
             resp.snapshot = self.proxy.get_snapshot(block.index())
         except Exception as e:
-            self.logger.error("FastForwardRequest: %s", e)
+            # full traceback: a donor that cannot serve (missing rounds,
+            # evicted events, stale anchors) starves every joiner — the
+            # exact failure site matters operationally
+            self.logger.error("FastForwardRequest: %s", e, exc_info=True)
             resp_err = str(e)
         rpc.respond(resp, error=resp_err)
 
