@@ -316,6 +316,117 @@ def run_frontier_passes(grid: DagGrid, d_max: Optional[int] = None) -> PassResul
     )
 
 
+def validate_round_writeback(hg, proposed) -> None:
+    """Boundary gate for every device->host round stamp: the host round
+    function is write-once and the source of all downstream consensus
+    metadata, so a single wrong stamp silently diverges the node forever
+    (observed on long-lived post-reset states: a re-joined node minting
+    one empty block per sync, thousands ahead of its peers). Before
+    anything is written, enforce two theorems of the hashgraph round
+    function on the whole batch:
+
+    1. never overwrite: an event with a known host round must be proposed
+       the SAME round;
+    2. parent bounds: round(e) is in [max(parent rounds), max + 1]
+       (rounds are non-decreasing along chains and advance by at most one
+       per event), checked against every parent whose round is resolvable
+       from the batch or the store.
+
+    Violations raise GridUnsupported — the caller's ladder falls back to
+    a sound engine instead of stamping garbage."""
+    from ..common import StoreErr
+
+    pro = dict(proposed)
+    for h, (rnum, lam) in pro.items():
+        ev = hg.store.get_event(h)
+        if ev.round is not None and ev.round != rnum:
+            raise GridUnsupported(
+                f"round write-back would overwrite {ev.round} with {rnum} "
+                f"({h[:18]}…)"
+            )
+        if (
+            lam is not None
+            and ev.lamport_timestamp is not None
+            and ev.lamport_timestamp != lam
+        ):
+            # lamports order events inside frames; overwriting one reorders
+            # committed frame bodies and diverges the FrameHash
+            raise GridUnsupported(
+                f"lamport write-back would overwrite {ev.lamport_timestamp} "
+                f"with {lam} ({h[:18]}…)"
+            )
+        pmax = None
+        lmax = None
+        lam_known = True
+        for ph in (ev.self_parent(), ev.other_parent()):
+            if not ph:
+                continue
+            pr = pl = None
+            got = pro.get(ph)
+            if got is not None:
+                pr, pl = got
+            else:
+                try:
+                    pev = hg.store.get_event(ph)
+                    pr, pl = pev.round, pev.lamport_timestamp
+                except StoreErr:
+                    pass
+            if pr is not None:
+                pmax = pr if pmax is None else max(pmax, pr)
+            if pl is not None:
+                lmax = pl if lmax is None else max(lmax, pl)
+            else:
+                lam_known = False
+        if pmax is not None and not (pmax <= rnum <= pmax + 1):
+            raise GridUnsupported(
+                f"round write-back violates parent bounds: {rnum} vs "
+                f"parents<= {pmax} ({h[:18]}…)"
+            )
+        if (
+            lam is not None and lam_known and lmax is not None
+            and lam != lmax + 1
+        ):
+            # lamport(e) is EXACTLY max(parent lamports) + 1 when every
+            # parent's lamport is resolvable
+            raise GridUnsupported(
+                f"lamport write-back violates parent identity: {lam} vs "
+                f"max(parents)+1 = {lmax + 1} ({h[:18]}…)"
+            )
+
+
+def admissible_receptions(hg, round_infos, proposed) -> bool:
+    """Boundary gate for device->host round_received stamps, mirroring the
+    host rule (decide_round_received): an event is received at round rr
+    only if every round in (round(x), rr] is known and fully fame-decided
+    in the HOST's state. The device recomputes fame over the whole grid
+    and can "unblock" a round the host froze forever (a late witness in an
+    already-decided round) — stamping such a reception diverges this node
+    from every host-disciplined peer.
+
+    Returns True iff EVERY proposal is admissible. On False the caller
+    must NOT stamp device receptions at all and instead run the host's
+    own decide_round_received for this call: merely skipping the
+    inadmissible ones would delay receptions past their round's block
+    composition and diverge block bodies from a host-engine peer."""
+    from ..common import StoreErr
+
+    for h, rr in proposed:
+        ev = hg.store.get_event(h)
+        r0 = ev.round if ev.round is not None else rr - 1
+        for i in range(r0 + 1, rr + 1):
+            ri = round_infos.get(i)
+            if ri is None:
+                try:
+                    ri = hg.store.get_round(i)
+                except StoreErr:
+                    if hg.reset_floor is not None and i <= hg.reset_floor:
+                        continue
+                    return False
+            if not ri.witnesses_decided():
+                return False
+    return True
+
+
 def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
     """Full five-pass pipeline with passes 1-3 on device.
 
@@ -340,6 +451,15 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
         res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
+    # validate the WHOLE batch before stamping anything: a partial stamp
+    # of wrong rounds poisons the host's (write-once) round function
+    validate_round_writeback(
+        hg,
+        (
+            (grid.hashes[r], (int(res.rounds[r]), int(res.lamport[r])))
+            for r in range(grid.e)
+        ),
+    )
     undetermined = set(hg.undetermined_events)
     row_of = {h: r for r, h in enumerate(grid.hashes)}
     round_infos = {}
@@ -368,6 +488,23 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
             ri.add_event(h, bool(res.witness[r]))
 
     # --- write-back: DecideFame (reference: hashgraph.go:852-947) ---
+    if hg.reset_floor is not None:
+        # POST-RESET DELEGATION: fame/reception DECISION TIMING must match
+        # the host engine call-for-call — block composition locks in when
+        # a round is processed, and on post-reset states the device's
+        # whole-grid fame can decide rounds on a different call than the
+        # host's pending-round scan (observed as a one-event difference in
+        # a committed block body between a cpu- and a tpu-backend joiner
+        # fed identical syncs). The device still contributes the O(E*N)
+        # DivideRounds bulk above; fame + received run host-side until the
+        # reset ages out.
+        for rnum, ri in round_infos.items():
+            hg.store.set_round(rnum, ri)
+        hg.decide_fame()
+        hg.decide_round_received()
+        hg.process_decided_rounds()
+        hg.process_sig_pool()
+        return
     # the (R, N) tables are indexed by round - round_offset (rebasing)
     decided_rounds = set()
     for pr in hg.pending_rounds:
@@ -391,24 +528,41 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
             pr.decided = True
 
     # --- write-back: DecideRoundReceived (reference: hashgraph.go:951-1036) ---
-    new_undetermined = []
-    for h in hg.undetermined_events:
-        rr = int(res.received[row_of[h]])
-        if rr >= 0:
-            ev = hg.store.get_event(h)
-            ev.set_round_received(rr)
-            hg.store.set_event(ev)
-            tri = round_infos.get(rr)
-            if tri is None:
-                tri = hg.store.get_round(rr)
-                round_infos[rr] = tri
-            tri.set_consensus_event(h)
-        else:
-            new_undetermined.append(h)
-    hg.undetermined_events = new_undetermined
+    rr_clean = admissible_receptions(
+        hg, round_infos,
+        (
+            (h, int(res.received[row_of[h]]))
+            for h in hg.undetermined_events
+            if int(res.received[row_of[h]]) >= 0
+        ),
+    )
+    if rr_clean:
+        new_undetermined = []
+        for h in hg.undetermined_events:
+            rr = int(res.received[row_of[h]])
+            if rr >= 0:
+                ev = hg.store.get_event(h)
+                ev.set_round_received(rr)
+                hg.store.set_event(ev)
+                tri = round_infos.get(rr)
+                if tri is None:
+                    tri = hg.store.get_round(rr)
+                    round_infos[rr] = tri
+                tri.set_consensus_event(h)
+            else:
+                new_undetermined.append(h)
+        hg.undetermined_events = new_undetermined
 
-    for rnum, ri in round_infos.items():
-        hg.store.set_round(rnum, ri)
+        for rnum, ri in round_infos.items():
+            hg.store.set_round(rnum, ri)
+    else:
+        # the device "unblocked" at least one reception the host rule
+        # refuses (post-reset frozen/missing rounds): persist the fame
+        # state and run the HOST's own reception pass this call — exact
+        # host timing, so block composition cannot skew
+        for rnum, ri in round_infos.items():
+            hg.store.set_round(rnum, ri)
+        hg.decide_round_received()
 
     # --- host passes 4-5 ---
     hg.process_decided_rounds()

@@ -226,6 +226,30 @@ class LiveDeviceEngine:
                 if (ev2.round is not None and ev2.round >= base) or h2 in undet:
                     kept_map[h2] = ev2
 
+        # ROUND CLOSURE: an event without a host round must be computable
+        # WITHIN the modeled window — both parents either carry known
+        # rounds or are themselves kept. _install_state stages no external
+        # round seeds (unlike grid_from_hashgraph, which seeds from roots
+        # and frozen refs), so an unrounded event with an out-of-window
+        # parent would be mis-derived as root-attached at the engine base
+        # (observed: a fresh post-fast-sync attach stamping base-relative
+        # rounds onto genesis events). Refuse and let the one-shot path —
+        # which has full external seeding — run until rounds settle; the
+        # attach succeeds on a later call.
+        def _parent_ok(ph: str) -> bool:
+            # membership only: a parent with a known round but OUTSIDE the
+            # window is still unusable — the engine has no row to read the
+            # round from and no external seed channel
+            return ph == "" or ph in kept_map
+        for h2, ev2 in kept_map.items():
+            if ev2.round is None and not (
+                _parent_ok(ev2.self_parent()) and _parent_ok(ev2.other_parent())
+            ):
+                raise GridUnsupported(
+                    f"attach: unrounded event with out-of-window parent "
+                    f"({h2[:18]}…)"
+                )
+
         # topological order (coordinates reference earlier rows only)
         kept = sorted(kept_map.items(), key=lambda kv: kv[1].topological_index)
         self._install_state(base, floor, kept)
@@ -343,8 +367,14 @@ class LiveDeviceEngine:
             la[k] = [c[0] for c in ev.last_ancestors]
             fd[k] = [c[0] for c in ev.first_descendants]
             if ev.round is not None:
-                rounds[k] = ev.round - base
-                last_abs = max(last_abs, ev.round)
+                if ev.round >= base:
+                    rounds[k] = ev.round - base
+                    last_abs = max(last_abs, ev.round)
+                # else: a still-undetermined event below the base — its
+                # reception is pending at rounds >= floor but its round
+                # cannot be represented base-relative; leave the sentinel
+                # (-1). The write-back never re-stamps host-known rounds,
+                # so the true round is preserved host-side.
             lamport[k] = (
                 ev.lamport_timestamp if ev.lamport_timestamp is not None else -1
             )
@@ -686,15 +716,41 @@ def run_consensus_live(hg) -> None:
         return arr[row - lo]
 
     # --- DivideRounds write-back for the new events -----------------------
+    # boundary gate: validate the whole batch before stamping (a wrong
+    # round poisons the write-once host round function; see
+    # engine.validate_round_writeback) — violations demote this engine
+    from .engine import validate_round_writeback
+
+    # host-known rounds are AUTHORITATIVE: never re-stamp them (a fresh
+    # attach write-back covers every staged row, including rows below the
+    # engine base whose device-side round is a sentinel)
+    def _fresh_rows():
+        for row in new_rows:
+            if hg.store.get_event(eng.hashes[row]).round is None:
+                yield row
+
+    validate_round_writeback(
+        hg,
+        (
+            (
+                eng.hashes[row],
+                (int(at(row, rounds_w)) + base, int(at(row, lamport_w))),
+            )
+            for row in _fresh_rows()
+        ),
+    )
     undetermined = set(hg.undetermined_events)
     round_infos: Dict[int, RoundInfo] = {}
     for row in new_rows:
         h = eng.hashes[row]
         ev = hg.store.get_event(h)
-        rnum = int(at(row, rounds_w)) + base
-        ev.set_round(rnum)
-        ev.set_lamport_timestamp(int(at(row, lamport_w)))
-        hg.store.set_event(ev)
+        if ev.round is None:
+            rnum = int(at(row, rounds_w)) + base
+            ev.set_round(rnum)
+            ev.set_lamport_timestamp(int(at(row, lamport_w)))
+            hg.store.set_event(ev)
+        else:
+            rnum = ev.round
         if h in undetermined:
             ri = round_infos.get(rnum)
             if ri is None:
@@ -714,8 +770,19 @@ def run_consensus_live(hg) -> None:
             ri.add_event(h, bool(at(row, witness_w)))
 
     # --- DecideFame write-back (pending rounds only) ----------------------
+    delegated = hg.reset_floor is not None
+    if delegated:
+        # post-reset delegation, same reasoning as engine.py: fame and
+        # reception decision TIMING must match the host call-for-call or
+        # block composition skews between backends. Falls through to the
+        # capacity management below — the engine still windows (rebases)
+        # like any other.
+        for rnum, ri in round_infos.items():
+            hg.store.set_round(rnum, ri)
+        hg.decide_fame()
+        hg.decide_round_received()
     decided_rounds = set()
-    for pr in hg.pending_rounds:
+    for pr in ([] if delegated else hg.pending_rounds):
         ri = round_infos.get(pr.index)
         if ri is None:
             ri = hg.store.get_round(pr.index)
@@ -735,26 +802,56 @@ def run_consensus_live(hg) -> None:
             pr.decided = True
 
     # --- DecideRoundReceived write-back (undetermined only) ---------------
-    new_undetermined = []
-    for h in hg.undetermined_events:
-        row = eng.row_of[h]
-        rr = int(at(row, received_w))
-        if rr >= 0:
-            rr += base
-            ev = hg.store.get_event(h)
-            ev.set_round_received(rr)
-            hg.store.set_event(ev)
-            tri = round_infos.get(rr)
-            if tri is None:
-                tri = hg.store.get_round(rr)
-                round_infos[rr] = tri
-            tri.set_consensus_event(h)
-        else:
-            new_undetermined.append(h)
-    hg.undetermined_events = new_undetermined
+    from .engine import admissible_receptions
 
-    for rnum, ri in round_infos.items():
-        hg.store.set_round(rnum, ri)
+    def _proposed_receptions():
+        for h in hg.undetermined_events:
+            row = eng.row_of.get(h)
+            if row is None:
+                continue
+            rr = int(at(row, received_w))
+            if rr >= 0:
+                yield h, rr + base
+
+    if not delegated:
+        if admissible_receptions(hg, round_infos, _proposed_receptions()):
+            new_undetermined = []
+            for h in hg.undetermined_events:
+                row = eng.row_of.get(h)
+                if row is None:
+                    # every undetermined event must be modeled (the attach
+                    # keeps undetermined events regardless of round);
+                    # anything unmodeled means the staging walk silently
+                    # lost one — demote rather than silently never
+                    # receiving it (that skews block composition)
+                    raise GridUnsupported(
+                        f"undetermined event unmodeled ({h[:18]}…)"
+                    )
+                rr = int(at(row, received_w))
+                if rr >= 0:
+                    rr += base
+                    ev = hg.store.get_event(h)
+                    ev.set_round_received(rr)
+                    hg.store.set_event(ev)
+                    tri = round_infos.get(rr)
+                    if tri is None:
+                        tri = hg.store.get_round(rr)
+                        round_infos[rr] = tri
+                    tri.set_consensus_event(h)
+                else:
+                    new_undetermined.append(h)
+            hg.undetermined_events = new_undetermined
+
+            for rnum, ri in round_infos.items():
+                hg.store.set_round(rnum, ri)
+        else:
+            # the device "unblocked" a reception the host rule refuses
+            # (frozen/missing rounds): persist the fame state and run the
+            # HOST's reception pass this call — exact host timing, so
+            # block composition cannot skew (engine.admissible_receptions)
+            for rnum, ri in round_infos.items():
+                hg.store.set_round(rnum, ri)
+            hg.decide_round_received()
 
     # --- host passes 4-5 --------------------------------------------------
     hg.process_decided_rounds()

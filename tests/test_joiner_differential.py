@@ -1,0 +1,142 @@
+"""Post-fast-sync backend differential: a cpu-backend and a tpu-backend
+joiner core fast-forward from IDENTICAL materials and are fed IDENTICAL
+post-join syncs — rounds, receptions, and blocks must match at every
+step.
+
+This is the regression net for the post-reset device divergence family
+found in round 3 (a re-joined tpu-backend node minting one empty block
+per sync, thousands ahead of its peers): the live attach staging
+unrounded out-of-window events as engine-base-attached, and device
+write-backs stamping rounds/receptions the host round function forbids.
+The fixes it pins: the attach's zombie-exclusion + round-closure guards
+(live.py), validate_round_writeback's never-overwrite/parent-bounds
+gates, and admissible_receptions' host-rule mirror (engine.py)."""
+
+import random
+
+import pytest
+
+from babble_tpu.hashgraph import Block, Frame, InmemStore, Section
+from babble_tpu.node import Core
+
+from test_core import init_cores, sync_and_run_consensus
+
+
+def run_joiner_differential(seed, steps, check_bodies=True):
+    rng = random.Random(seed)
+    cores, _, _ = init_cores(4)
+
+    i = 0
+    while cores[0].get_last_block_index() < 3:
+        a = rng.randrange(3)
+        b = (a + 1 + rng.randrange(2)) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 3000
+
+    blk = cores[0].hg.store.get_block(1)
+    for c in cores[:3]:
+        blk.set_signature(blk.sign(c.key))
+    cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+    section = cores[0].hg.get_section(frame.round, block.index())
+
+    def make_joiner(backend):
+        j = Core(
+            3, cores[3].key, cores[0].participants,
+            InmemStore(cores[0].participants, 5000), None,
+            consensus_backend=backend,
+        )
+        j.fast_forward(
+            cores[0].hex_id(),
+            Block.from_json(block.to_json()),
+            Frame.from_json(frame.to_json()),
+            Section.from_json(section.to_json()),
+        )
+        return j
+
+    j_cpu = make_joiner("cpu")
+    j_tpu = make_joiner("tpu")
+
+    def compare(tag):
+        for p in cores[0].participants.to_peer_slice():
+            pk = p.pub_key_hex
+            try:
+                h, is_root = j_cpu.hg.store.last_event_from(pk)
+            except Exception:  # noqa: BLE001
+                continue
+            while h and not is_root:
+                try:
+                    ec = j_cpu.hg.store.get_event(h)
+                    et = j_tpu.hg.store.get_event(h)
+                except Exception:  # noqa: BLE001
+                    break
+                assert ec.round == et.round, (
+                    f"{tag}: round diverged on ({pk[:12]}, {ec.index()}): "
+                    f"cpu {ec.round} vs tpu {et.round}"
+                )
+                assert ec.round_received == et.round_received, (
+                    f"{tag}: reception diverged on ({pk[:12]}, {ec.index()}):"
+                    f" cpu {ec.round_received} vs tpu {et.round_received}"
+                )
+                h = ec.self_parent()
+        assert j_cpu.get_last_block_index() == j_tpu.get_last_block_index(), (
+            f"{tag}: blocks diverged cpu={j_cpu.get_last_block_index()} "
+            f"tpu={j_tpu.get_last_block_index()}"
+        )
+        if check_bodies:
+            hi = j_cpu.get_last_block_index()
+            for bi in range(max(0, hi - 2), hi + 1):
+                assert (
+                    j_cpu.hg.store.get_block(bi).body.marshal()
+                    == j_tpu.hg.store.get_block(bi).body.marshal()
+                ), f"{tag}: block {bi} body diverged"
+
+    for step in range(steps):
+        a = rng.randrange(3)
+        b = (a + 1 + rng.randrange(2)) % 3
+        sync_and_run_consensus(cores, a, b, [f"post{step}".encode()])
+        if step % 3 == 0:
+            src = cores[rng.randrange(3)]
+            for j in (j_cpu, j_tpu):
+                known = j.known_events()
+                diff = src.event_diff(known)
+                wire = src.to_wire(diff)
+                j.add_transactions([f"jtx{step}".encode()])
+                j.sync(wire)
+                j.run_consensus()
+            known0 = cores[a].known_events()
+            jd = j_cpu.event_diff(known0)
+            if jd:
+                cores[a].sync(j_cpu.to_wire(jd))
+                cores[a].run_consensus()
+            compare(f"step {step}")
+
+    assert j_tpu.device_consensus_runs > 0, (
+        "tpu joiner never ran the device engine — the differential "
+        "degenerated into cpu-vs-cpu"
+    )
+
+
+def test_joiner_differential_seed1():
+    run_joiner_differential(seed=1, steps=150, check_bodies=False)
+
+
+def test_joiner_differential_seed3():
+    run_joiner_differential(seed=3, steps=150, check_bodies=False)
+
+
+@pytest.mark.xfail(
+    strict=False,
+    reason="OPEN DEFECT (round 3): post-reset block COMPOSITION timing — "
+    "with rounds/lamports/receptions bit-equal, a block sealed one call "
+    "apart on the two backends can differ by an event whose reception "
+    "landed between their process_decided_rounds calls. The corruption "
+    "class (garbage rounds, runaway minting) is fixed and pinned by the "
+    "value tests above; full per-call composition fidelity on post-reset "
+    "states needs the device write-back to mirror the host's "
+    "decision-to-processing interleaving exactly.",
+)
+def test_joiner_differential_block_bodies():
+    run_joiner_differential(seed=1, steps=150, check_bodies=True)
