@@ -4,13 +4,18 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test bench demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test bench soak demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
 
 bench:
 	$(PY) bench.py
+
+# adversarial-timing fast-sync soak (VERDICT r3 #5): chained-donor
+# fast-forward + device-engine reattach scenarios with stall diagnostics
+soak:
+	$(PY) scripts/soak_fastsync.py all --iters 10
 
 multichip:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

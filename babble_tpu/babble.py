@@ -40,6 +40,10 @@ class BabbleConfig:
     data_dir: str = field(default_factory=default_data_dir)
     bind_addr: str = ":1337"
     service_addr: str = ""  # "" = no HTTP service
+    # allow /debug/* (stack dumps, sampling profiler) from non-loopback
+    # clients; off by default — the profiler can hold a GIL-contending
+    # sampling loop for up to 60s per request
+    service_remote_debug: bool = False
     max_pool: int = 2
     store: bool = False  # False = in-memory, True = sqlite under data_dir
     log_level: str = "info"
@@ -132,7 +136,8 @@ class Babble:
     def _init_service(self) -> None:
         if self.config.service_addr:
             self.service = Service(
-                self.config.service_addr, self.node, self.logger
+                self.config.service_addr, self.node, self.logger,
+                remote_debug=self.config.service_remote_debug,
             )
 
     # -- run (reference: babble.go:203-209) ------------------------------

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="IP:Port to connect to the client app")
     run.add_argument("-s", "--service-listen", default="",
                      help="Listen IP:Port for the HTTP service")
+    run.add_argument("--service-remote-debug", action="store_true",
+                     help="Allow /debug/* (profiler, stack dumps) from "
+                          "non-loopback clients")
     run.add_argument("--store", action="store_true",
                      help="Use persistent on-disk store instead of in-mem")
     run.add_argument("--cache-size", type=int, default=500,
@@ -126,7 +129,8 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "log": "log", "listen": "listen", "timeout": "timeout",
         "max-pool": "max_pool", "standalone": "standalone",
         "proxy-listen": "proxy_listen", "client-connect": "client_connect",
-        "service-listen": "service_listen", "store": "store",
+        "service-listen": "service_listen",
+        "service-remote-debug": "service_remote_debug", "store": "store",
         "cache-size": "cache_size", "heartbeat": "heartbeat",
         "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
     }
@@ -156,6 +160,7 @@ def run_command(args: argparse.Namespace) -> int:
         data_dir=args.datadir,
         bind_addr=args.listen,
         service_addr=args.service_listen,
+        service_remote_debug=args.service_remote_debug,
         max_pool=args.max_pool,
         store=args.store,
         log_level=args.log,

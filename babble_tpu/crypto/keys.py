@@ -24,6 +24,21 @@ from cryptography.exceptions import InvalidSignature
 
 _CURVE = ec.SECP256R1()
 _PREHASHED = Prehashed(hashes.SHA256())
+# RFC 6979 deterministic nonces: same key + same digest => same (r, s).
+# The reference signs with randomized nonces (src/crypto/utils.go:29-37),
+# which standard verification accepts either way — but determinism is a
+# strictly stronger contract this framework relies on: the signature's r
+# value is the Lamport tie-breaker in consensus ordering (event.py), so a
+# validator that re-signs an identical event body (crash replay, backend
+# differential, process restart) must reproduce the same bytes or two
+# otherwise bit-equal nodes order frames differently.
+try:
+    _SIGN_ALG = ec.ECDSA(_PREHASHED, deterministic_signing=True)
+except TypeError as _e:  # cryptography < 42 lacks the keyword
+    raise ImportError(
+        "babble-tpu requires cryptography>=42.0 for RFC 6979 deterministic "
+        "ECDSA (consensus ordering tie-breaks on signature bytes)"
+    ) from _e
 
 PEM_KEY_FILE = "priv_key.pem"
 
@@ -64,8 +79,10 @@ def pub_key_from_bytes(data: bytes) -> Optional[ec.EllipticCurvePublicKey]:
 
 
 def sign(key: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
-    """Sign a precomputed SHA-256 digest; returns (r, s)."""
-    der = key.sign(digest, ec.ECDSA(_PREHASHED))
+    """Sign a precomputed SHA-256 digest; returns (r, s). Deterministic
+    (RFC 6979): signing the same digest with the same key reproduces the
+    same signature bytes."""
+    der = key.sign(digest, _SIGN_ALG)
     return decode_dss_signature(der)
 
 

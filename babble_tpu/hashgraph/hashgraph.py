@@ -110,6 +110,9 @@ class Hashgraph:
         # the anchor round of the last applied section — rounds at or below
         # it are undecidable here and skipped in the round-received scan
         self.frozen_refs: Dict[str, FrozenRef] = {}
+        # (index, frame_hash, sig-set) -> valid-signature count; see
+        # _block_proof_count
+        self._proof_count_cache: Dict[tuple, int] = {}
         self.reset_floor: Optional[int] = None
         # optional hook: called as (event, fd_writes) after every insert —
         # the incremental device engine's delta feed (babble_tpu/tpu/live.py)
@@ -966,6 +969,11 @@ class Hashgraph:
                 next_index += 1
             if cut_round is not None:
                 frames = [f for f in frames if f.round <= cut_round]
+                # the joiner's apply_section scrubs all decided metadata
+                # above its shipped-frame ceiling regardless (advisor r3:
+                # donor-stamped rounds above the cut must not seed block
+                # composition); don't ship what will be ignored
+                rounds = {r: ri for r, ri in rounds.items() if r <= cut_round}
         base_meta = [
             FrozenRef(
                 hash=ev.hex(),
@@ -1037,6 +1045,20 @@ class Hashgraph:
             if not ev.verify():
                 raise ValueError("Invalid Event signature in fast-sync section")
 
+        # frames must be the contiguous round range above the anchor (the
+        # donor builds exactly that, get_section) — gaps would desynchronize
+        # the frame->block index chain that pairs proofs with frames, and a
+        # round "skipped" by the donor would keep donor-stamped metadata
+        # below the scrub ceiling without any frame to pin it
+        expected = section.anchor_round + 1
+        for f in section.frames:
+            if f.round != expected:
+                raise ValueError(
+                    "fast-sync section: frames not contiguous from the anchor"
+                    f" (got round {f.round}, want {expected})"
+                )
+            expected += 1
+
         sig_lag_floor = (
             max(f.round for f in section.frames) - 2 if section.frames else -1
         )
@@ -1088,11 +1110,76 @@ class Hashgraph:
                     "fast-sync section: consensus baseline above the anchor"
                 )
 
-    def apply_section(self, section: Section) -> None:
+    def _section_trusted_ceiling(self, anchor_index: int, section: Section) -> int:
+        """Highest round of donor-DECIDED state the joiner accepts from a
+        section. Walk the shipped frames in round order (contiguity is
+        enforced by verify_section), chaining block indices exactly like
+        process_decided_rounds, and extend the proven prefix on every
+        non-empty frame whose proof block carries >1/3 valid validator
+        signatures. The ceiling is that proven prefix plus the two-round
+        signature-lag window (a block's signatures ride strictly LATER
+        self-events, so the freshest two rounds cannot have proofs yet) —
+        anchored to the proven prefix, NOT to the donor-controlled frame
+        list: fabricated frames (empty-round padding included) cannot lift
+        it, because padding never extends `last_proven`."""
+        frames = sorted(section.frames, key=lambda f: f.round)
+        if not frames:
+            return section.anchor_round
+        last_proven = section.anchor_round  # the anchor block is check_block-verified
+        next_index = anchor_index + 1
+        for f in frames:
+            if not f.events:
+                continue  # empty rounds mint no block; covered transitively
+                # by the index chain when a later frame proves
+            valid = self._block_proof_count(
+                f, section.proof_blocks.get(next_index), next_index
+            )
+            if valid <= self.trust_count:
+                break
+            last_proven = f.round
+            next_index += 1
+        return min(frames[-1].round, last_proven + 2)
+
+    def apply_section(self, section: Section, anchor_index: int = -1) -> None:
         """Joiner side: replay the donor's decided state above the anchor.
         Must run right after reset(block, frame); run_consensus() afterwards
         rebuilds the donor's blocks byte-identically via the shipped frames
-        and then continues live from the donor's frontier."""
+        and then continues live from the donor's frontier.
+        `anchor_index` is the verified anchor block's index (proof-chain
+        base for the scrub ceiling).
+
+        SCRUB CEILING (round 4, advisor finding): donor authority over
+        DECIDED consensus state extends exactly as far as the proof-checked
+        frame prefix plus the signature-lag window
+        (_section_trusted_ceiling) — the anchor round itself if no frame
+        proves. Above that ceiling, frames, RoundInfo snapshots, and event
+        round/lamport/round-received stamps are unproven donor metadata:
+        process_decided_rounds rebuilds blocks from stored frames and
+        RoundInfo consensus membership, so accepting a "decided" round
+        above the provable prefix would commit a donor-fabricated block.
+        Everything above the ceiling is therefore dropped here and
+        RE-DECIDED by this node's own consensus passes over the
+        (signature-checked) shipped events — divide_rounds recomputes
+        rounds/lamports grounded in the pinned anchor metadata and
+        re-queues the rounds, decide_fame re-votes, decide_round_received
+        re-stamps. The residual trust surface is the two-round sig-lag
+        window (verify_section) plus sub-consensus metadata of the proven
+        prefix (witness sets, frozen-ref coordinates), which cannot mint
+        blocks on its own."""
+        cut = self._section_trusted_ceiling(anchor_index, section)
+        # events/rounds/frames are this joiner's own deserialized copies
+        # (core.prepare_fast_forward round-trips the section through the
+        # wire codec before any of this runs), so stripping in place is safe
+        events: List[Event] = section.events
+        for ev in events:
+            if ev.round_received is not None and ev.round_received > cut:
+                ev.set_round_received(None)
+            if ev.round is not None and ev.round > cut:
+                ev.set_round(None)
+                ev.set_lamport_timestamp(None)
+        rounds = {r: ri for r, ri in section.rounds.items() if r <= cut}
+        frames = [f for f in section.frames if f.round <= cut]
+
         # the frame base is settled by definition (anchored in the block);
         # it must never be re-received into a later round
         for h in self.undetermined_events:
@@ -1103,6 +1190,12 @@ class Hashgraph:
         self.reset_floor = section.anchor_round
 
         self.frozen_refs.update({fr.hash: fr for fr in section.frozen_refs})
+        # frozen refs ground the round/lamport recursion for re-decided
+        # events whose other-parents sit below the cut (the event bodies
+        # never ship, so the recursion cannot reach past them)
+        for fr in section.frozen_refs:
+            self._round_cache.setdefault(fr.hash, fr.round)
+            self._timestamp_cache.setdefault(fr.hash, fr.lamport)
         # adopt the donor's last-consensus-event baseline: the anchor round
         # itself is never replayed (it is settled by the frame), so without
         # this the joiner's frame roots for participants quiet since the
@@ -1121,24 +1214,27 @@ class Hashgraph:
             ev.set_round(fr.round)
             ev.set_lamport_timestamp(fr.lamport)
             self.store.set_event(ev)
-        for f in section.frames:
+        for f in frames:
             self.store.set_frame(f)
-        for r in sorted(section.rounds):
-            ri = section.rounds[r]
+        for r in sorted(rounds):
+            ri = rounds[r]
             ri.queued = True  # pending status is tracked below
             self.store.set_round(r, ri)
 
         # event signatures were checked by verify_section (fast_forward
         # always validates before applying); re-verifying here would double
         # the dominant ECDSA cost of catch-up
-        for ev in section.events:
+        for ev in events:
             self._check_self_parent(ev)
             self._check_other_parent(ev)
             ev.topological_index = self.topological_index
             self.topological_index += 1
-            # authoritative donor metadata — not recomputed
-            self._round_cache[ev.hex()] = ev.round
-            self._timestamp_cache[ev.hex()] = ev.lamport_timestamp
+            # authoritative donor metadata below the scrub ceiling — not
+            # recomputed; scrubbed events (None) are re-decided instead
+            if ev.round is not None:
+                self._round_cache[ev.hex()] = ev.round
+            if ev.lamport_timestamp is not None:
+                self._timestamp_cache[ev.hex()] = ev.lamport_timestamp
             self.store.set_event(ev)
             if ev.round_received is None:
                 self.undetermined_events.append(ev.hex())
@@ -1150,8 +1246,8 @@ class Hashgraph:
             self.sig_pool.extend(ev.block_signatures())
 
         self.pending_rounds = [
-            PendingRound(r, section.rounds[r].witnesses_decided())
-            for r in sorted(section.rounds)
+            PendingRound(r, rounds[r].witnesses_decided())
+            for r in sorted(rounds)
         ]
 
     def bootstrap(self) -> None:
@@ -1246,7 +1342,23 @@ class Hashgraph:
             or proof.frame_hash() != frame.hash()
         ):
             return 0
-        return self.valid_signature_count(proof, limit=self.trust_count + 1)
+        # memoized: verify_section and _section_trusted_ceiling walk the
+        # same (frame, proof) pairs back to back within one fast_forward,
+        # and ECDSA verification dominates catch-up cost. Key covers the
+        # full pairing identity plus the signature set.
+        key = (
+            expected_index,
+            proof.frame_hash(),
+            tuple(sorted(proof.signatures.items())),
+        )
+        cached = self._proof_count_cache.get(key)
+        if cached is not None:
+            return cached
+        count = self.valid_signature_count(proof, limit=self.trust_count + 1)
+        if len(self._proof_count_cache) > 256:
+            self._proof_count_cache.clear()
+        self._proof_count_cache[key] = count
+        return count
 
     def check_block(self, block: Block) -> None:
         """Valid iff strictly more than 1/3 of participants signed."""

@@ -191,7 +191,7 @@ class Core:
         consensus continuation). Args must come from prepare_fast_forward."""
         self.hg.reset(block, frame)
         if section is not None:
-            self.hg.apply_section(section)
+            self.hg.apply_section(section, block.index())
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
         # the live engine's device state is desynced from the reset store:

@@ -86,6 +86,12 @@ class Node(NodeStateMachine):
         self.start_time = time.monotonic()
         self.sync_requests = 0
         self.sync_errors = 0
+        # CatchingUp->Babbling bounces from the fast-forward rewind guards:
+        # self-resolving in ordinary operation, but a node stuck ping-ponging
+        # (crashed before gossiping its newest own events while genuinely
+        # behind) must be operationally visible (ADVICE r3)
+        self.fast_forward_bounces = 0
+        self._consecutive_bounces = 0
 
         self.need_bootstrap = store.need_bootstrap()
         self.set_starting(True)
@@ -388,23 +394,24 @@ class Node(NodeStateMachine):
             # behind in EVENTS (even at an equal block index) has a stale
             # own chain and applies safely, gaining the section's events.
             if resp.block.index() < self.core.get_last_block_index():
-                self.logger.debug(
-                    "fast_forward: anchor %d behind our block %d — resuming",
-                    resp.block.index(), self.core.get_last_block_index(),
+                self._count_bounce(
+                    "fast_forward: anchor %d behind our block %d — resuming"
+                    % (resp.block.index(), self.core.get_last_block_index())
                 )
                 self.set_state(NodeState.BABBLING)
                 self.set_starting(True)
                 return
             my_frame_idx = self._own_index_in(resp.frame, resp.section)
             if self.core.seq > my_frame_idx:
-                self.logger.debug(
+                self._count_bounce(
                     "fast_forward: reset would rewind own chain "
-                    "(seq %d > frame %d) — not actually behind, resuming",
-                    self.core.seq, my_frame_idx,
+                    "(seq %d > frame %d) — not actually behind, resuming"
+                    % (self.core.seq, my_frame_idx)
                 )
                 self.set_state(NodeState.BABBLING)
                 self.set_starting(True)
                 return
+            self._consecutive_bounces = 0
             # validate first (no state mutated), THEN restore the app, THEN
             # apply: the restore must precede the apply because the section
             # replays blocks above the anchor through the commit channel
@@ -507,6 +514,20 @@ class Node(NodeStateMachine):
     # stats
     # ------------------------------------------------------------------
 
+    def _count_bounce(self, msg: str) -> None:
+        """Track a fast-forward rewind-guard bounce; escalate the log level
+        once bounces repeat without an intervening successful fast-forward
+        (a stuck catch-up loop is self-resolving but must be visible above
+        debug level, ADVICE r3)."""
+        self.fast_forward_bounces += 1
+        self._consecutive_bounces += 1
+        log = (
+            self.logger.info
+            if self._consecutive_bounces >= 3
+            else self.logger.debug
+        )
+        log("%s (consecutive bounces: %d)", msg, self._consecutive_bounces)
+
     def get_stats(self) -> Dict[str, str]:
         elapsed = time.monotonic() - self.start_time
         consensus_events = self.core.get_consensus_events_count()
@@ -543,6 +564,9 @@ class Node(NodeStateMachine):
             # a degraded TPU node AND see it heal)
             "live_engine_demotions": str(self.core.live_demotions),
             "live_engine_reattaches": str(self.core.live_reattaches),
+            # rewind-guard bounces out of CatchingUp (ADVICE r3): a stuck
+            # catch-up ping-pong shows up here instead of hiding at debug
+            "fast_forward_bounces": str(self.fast_forward_bounces),
             **self._live_engine_stats(),
         }
 

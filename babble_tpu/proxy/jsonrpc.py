@@ -27,13 +27,18 @@ class JSONRPCError(Exception):
 # (net/tcp_transport.py DEFAULT_MAX_FRAME)
 DEFAULT_MAX_LINE = 64 << 20
 
-# client-side proactive reconnect age: safely below JSONRPCServer's default
-# idle_timeout (600 s), so a recycled-by-the-server connection is replaced
-# BEFORE a request is sent on it — never by resending after a failure,
-# which could double-execute a non-idempotent call (State.CommitBlock
-# applied twice silently diverges the app state: "hung up without
-# replying" does not guarantee "not executed")
-DEFAULT_IDLE_RECONNECT = 540.0
+# server-side idle connection recycling age
+DEFAULT_IDLE_TIMEOUT = 600.0
+
+# client-side proactive reconnect age: DERIVED from the server timeout
+# (90%) so the two ends cannot drift apart — a recycled-by-the-server
+# connection is replaced BEFORE a request is sent on it, never by
+# resending after a failure, which could double-execute a non-idempotent
+# call (State.CommitBlock applied twice silently diverges the app state:
+# "hung up without replying" does not guarantee "not executed").
+# Anyone constructing a JSONRPCServer with a custom idle_timeout must give
+# its clients an idle_reconnect strictly below it for the same reason.
+DEFAULT_IDLE_RECONNECT = 0.9 * DEFAULT_IDLE_TIMEOUT
 
 
 def _read_bounded_line(rfile, max_line: int):
@@ -157,7 +162,8 @@ class JSONRPCServer:
     """
 
     def __init__(self, bind_addr: str, max_line: int = DEFAULT_MAX_LINE,
-                 max_inbound: int = 64, idle_timeout: float = 600.0):
+                 max_inbound: int = 64,
+                 idle_timeout: float = DEFAULT_IDLE_TIMEOUT):
         host, port = split_hostport(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

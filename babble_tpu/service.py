@@ -90,12 +90,28 @@ def profile_process(seconds: float, hz: float = 100.0) -> str:
 
 
 class Service:
-    def __init__(self, bind_address: str, node, logger: Optional[logging.Logger] = None):
+    def __init__(
+        self,
+        bind_address: str,
+        node,
+        logger: Optional[logging.Logger] = None,
+        remote_debug: bool = False,
+    ):
         self.bind_address = bind_address
         self.node = node
         self.logger = logger or logging.getLogger("babble.service")
+        # /debug/* can hold the profiler's GIL-contending sampling loop
+        # for up to 60s per request — loopback-only unless explicitly
+        # opted in (the stats port is often network-reachable; pprof
+        # exposure is restricted the same way in production Go services)
+        self.remote_debug = remote_debug
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def debug_allowed(self, client_ip: str) -> bool:
+        return self.remote_debug or client_ip in (
+            "127.0.0.1", "::1", "::ffff:127.0.0.1",
+        )
 
     def serve(self) -> None:
         """Start serving in a background thread (idempotent)."""
@@ -114,14 +130,25 @@ class Service:
                         body = json.dumps(
                             service.node.get_block(index).to_json()
                         ).encode()
-                    elif self.path == "/debug/stacks":
-                        body = thread_stacks().encode()
-                        ctype = "text/plain"
-                    elif self.path.startswith("/debug/profile"):
-                        q = parse_qs(urlparse(self.path).query)
-                        secs = float(q.get("seconds", ["5"])[0])
-                        body = profile_process(min(max(secs, 0.1), 60.0)).encode()
-                        ctype = "text/plain"
+                    elif self.path.startswith("/debug/"):
+                        if not service.debug_allowed(self.client_address[0]):
+                            self.send_error(
+                                403, "debug endpoints are loopback-only"
+                            )
+                            return
+                        if self.path == "/debug/stacks":
+                            body = thread_stacks().encode()
+                            ctype = "text/plain"
+                        elif self.path.startswith("/debug/profile"):
+                            q = parse_qs(urlparse(self.path).query)
+                            secs = float(q.get("seconds", ["5"])[0])
+                            body = profile_process(
+                                min(max(secs, 0.1), 60.0)
+                            ).encode()
+                            ctype = "text/plain"
+                        else:
+                            self.send_error(404)
+                            return
                     else:
                         self.send_error(404)
                         return

@@ -412,7 +412,13 @@ def admissible_receptions(hg, round_infos, proposed) -> bool:
 
     for h, rr in proposed:
         ev = hg.store.get_event(h)
-        r0 = ev.round if ev.round is not None else rr - 1
+        if ev.round is None:
+            # the host rule checks every round in (round(x), rr]; with the
+            # event's round unknown that range is unknowable — force the
+            # host's own reception pass rather than guess (DivideRounds
+            # write-back normally runs first, but nothing enforces it)
+            return False
+        r0 = ev.round
         for i in range(r0 + 1, rr + 1):
             ri = round_infos.get(i)
             if ri is None:

@@ -451,3 +451,76 @@ def test_verify_section_rejects_non_validator_signatures():
         fake_anchor.set_signature(fake_anchor.sign(k))
     with pytest.raises(ValueError):
         joiner.hg.check_block(fake_anchor)
+
+
+def test_section_scrub_drops_unproven_decided_metadata():
+    """ADVICE r3 (medium): donor-stamped DECIDED state above the
+    proof-checked frame prefix (+ the two-round sig-lag window) must not
+    seed the joiner's block composition. The attacker pads the section
+    with fabricated contiguous EMPTY frames (exempt from per-block proof
+    pairing — they mint no block) to lift the shipped-frame ceiling,
+    plants a fully-'decided' RoundInfo above the proven prefix, and
+    stamps a shipped event as received there. The joiner must scrub all
+    of it (Hashgraph.apply_section) and RE-DECIDE through its own
+    consensus passes: replayed blocks byte-match the donor's real chain
+    and the forged reception never lands."""
+    cores, keys, _ = init_cores(4)
+    i = 0
+    while cores[0].get_last_block_index() < 5:
+        a, b = i % 3, (i + 1) % 3
+        sync_and_run_consensus(cores, a, b, [f"tx{i}".encode()])
+        i += 1
+        assert i < 1500, "3-core playbook failed to make blocks"
+
+    for bi in range(1, cores[0].get_last_block_index() + 1):
+        blk = cores[0].hg.store.get_block(bi)
+        for c in cores[:3]:
+            blk.set_signature(blk.sign(c.key))
+        cores[0].hg.store.set_block(blk)
+    cores[0].hg.anchor_block = 1
+    block, frame = cores[0].get_anchor_block_with_frame()
+    section = cores[0].hg.get_section(frame.round, block.index())
+
+    from babble_tpu.hashgraph import Frame, RoundInfo, Section
+
+    forged = Section.from_json(section.to_json())
+    top = max(f.round for f in forged.frames)
+    roots = forged.frames[-1].roots
+    for r in range(top + 1, top + 5):
+        forged.frames.append(Frame(round=r, roots=roots, events=[]))
+    target_round = top + 4
+    victim = next(ev for ev in forged.events if ev.round_received is None)
+    victim.set_round(target_round)
+    victim.set_round_received(target_round)
+    ri = RoundInfo()
+    ri.add_event(victim.hex(), witness=True)
+    ri.set_fame(victim.hex(), True)
+    ri.set_consensus_event(victim.hex())
+    forged.rounds[target_round] = ri
+
+    joiner = Core(
+        3, cores[3].key, cores[0].participants,
+        InmemStore(cores[0].participants, 1000), None,
+    )
+    joiner.fast_forward(cores[0].hex_id(), block, frame, forged)
+
+    # no fabricated block: everything committed matches the donor's chain
+    for bi in range(block.index() + 1, joiner.get_last_block_index() + 1):
+        assert (
+            joiner.hg.store.get_block(bi).body.marshal()
+            == cores[0].hg.store.get_block(bi).body.marshal()
+        ), f"block {bi} diverged from the donor's real chain"
+    # the forged reception did not survive the scrub
+    jev = joiner.hg.store.get_event(victim.hex())
+    assert jev.round_received != target_round
+
+    # a section with a round GAP in its frames must be rejected outright
+    # (gaps desynchronize the frame->block proof index chain)
+    gapped = Section.from_json(section.to_json())
+    assert len(gapped.frames) > 1, "fixture must ship a multi-frame section"
+    del gapped.frames[0]
+    with pytest.raises(ValueError):
+        Core(
+            3, cores[3].key, cores[0].participants,
+            InmemStore(cores[0].participants, 1000), None,
+        ).fast_forward(cores[0].hex_id(), block, frame, gapped)

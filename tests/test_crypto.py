@@ -11,6 +11,19 @@ def test_sign_verify_roundtrip():
     assert not crypto.verify(key.public_key(), crypto.sha256(b"other"), r, s)
 
 
+def test_sign_deterministic():
+    """RFC 6979: same key + same digest => same signature bytes. The
+    signature's r value is the Lamport tie-breaker in consensus ordering,
+    so a validator re-signing an identical event body (crash replay,
+    backend differential) must reproduce the same bytes — two separately
+    constructed key objects over the same PEM material included."""
+    key = crypto.generate_key()
+    digest = crypto.sha256(b"determinism")
+    assert crypto.sign(key, digest) == crypto.sign(key, digest)
+    clone = crypto.key_from_pem(crypto.key_to_pem(key).encode())
+    assert crypto.sign(clone, digest) == crypto.sign(key, digest)
+
+
 def test_signature_encoding_roundtrip():
     key = crypto.generate_key()
     digest = crypto.sha256(b"payload")

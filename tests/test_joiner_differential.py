@@ -120,23 +120,28 @@ def run_joiner_differential(seed, steps, check_bodies=True):
 
 
 def test_joiner_differential_seed1():
-    run_joiner_differential(seed=1, steps=150, check_bodies=False)
+    run_joiner_differential(seed=1, steps=150, check_bodies=True)
 
 
 def test_joiner_differential_seed3():
-    run_joiner_differential(seed=3, steps=150, check_bodies=False)
+    run_joiner_differential(seed=3, steps=150, check_bodies=True)
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="OPEN DEFECT (round 3): post-reset block COMPOSITION timing — "
-    "with rounds/lamports/receptions bit-equal, a block sealed one call "
-    "apart on the two backends can differ by an event whose reception "
-    "landed between their process_decided_rounds calls. The corruption "
-    "class (garbage rounds, runaway minting) is fixed and pinned by the "
-    "value tests above; full per-call composition fidelity on post-reset "
-    "states needs the device write-back to mirror the host's "
-    "decision-to-processing interleaving exactly.",
-)
-def test_joiner_differential_block_bodies():
-    run_joiner_differential(seed=1, steps=150, check_bodies=True)
+@pytest.mark.parametrize("seed", [2, 4, 5, 6, 7, 8, 9, 10, 11, 12])
+def test_joiner_differential_block_bodies(seed):
+    """STRICT per-call block-body equality between a cpu- and a tpu-backend
+    joiner (closed round-4; was the round-3 open defect).
+
+    The round-3 divergence was never a backend skew: the two joiner
+    INSTANCES share one validator key and each signed its own events with
+    randomized ECDSA nonces, so their own-chain events serialized
+    differently — different frame bytes, different block bodies — and a
+    cpu-vs-cpu joiner pair failed identically (reproduced 12/12 seeds,
+    always at the first body compare with joiner events in a block).
+    RFC 6979 deterministic signing (crypto/keys.py) makes same-key
+    same-body signatures byte-equal, and with the per-call fame/reception
+    delegation on post-reset states (engine.py, live.py) the two backends
+    now seal byte-identical blocks at every compare point. Failures
+    historically surfaced by step 24; 45 steps gives margin while keeping
+    ten seeds affordable in the default suite."""
+    run_joiner_differential(seed=seed, steps=45, check_bodies=True)
