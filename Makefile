@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test bench soak demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test bench soak dist demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -16,6 +16,11 @@ bench:
 # fast-forward + device-engine reattach scenarios with stall diagnostics
 soak:
 	$(PY) scripts/soak_fastsync.py all --iters 10
+
+# wheel build (reference: makefile:5-21 / scripts/dist.sh); docker/
+# installs from dist/
+dist:
+	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist .
 
 multichip:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

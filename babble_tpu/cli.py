@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Max number of events for sync")
     run.add_argument("--consensus-backend", default="cpu", choices=("cpu", "tpu"),
                      help="Run the five-pass pipeline on host (cpu) or device (tpu)")
+    run.add_argument("--mesh-devices", type=int, default=0,
+                     help="With --consensus-backend=tpu: shard the device "
+                          "passes over this many chips (0 = single device)")
 
     kg = sub.add_parser("keygen", help="Create new key pair")
     kg.add_argument("--datadir", default=default_data_dir(),
@@ -133,6 +136,7 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "service-remote-debug": "service_remote_debug", "store": "store",
         "cache-size": "cache_size", "heartbeat": "heartbeat",
         "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
+        "mesh-devices": "mesh_devices",
     }
     for file_key, attr in mapping.items():
         if file_key in cfg and attr not in explicit:
@@ -171,6 +175,7 @@ def run_command(args: argparse.Namespace) -> int:
             cache_size=args.cache_size,
             sync_limit=args.sync_limit,
             consensus_backend=args.consensus_backend,
+            mesh_devices=args.mesh_devices,
             logger=logger,
         ),
     )

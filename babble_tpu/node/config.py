@@ -25,6 +25,12 @@ class Config:
     # state the dense grid cannot express (SURVEY §7 swappable-backend plan;
     # reference boundary: src/node/core.go:335-377)
     consensus_backend: str = "cpu"
+    # with consensus_backend="tpu": shard the device passes over this many
+    # chips as a jax.sharding.Mesh (0/1 = single device). The mesh path
+    # routes through babble_tpu/tpu/sharded.py (rounds-sharded fame with
+    # ppermute ring shifts, events/chains-sharded tables); any state it
+    # cannot express falls down the same ladder as the single-device path
+    mesh_devices: int = 0
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

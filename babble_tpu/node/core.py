@@ -30,6 +30,7 @@ class Core:
         commit_ch: Optional["queue.Queue[Block]"] = None,
         logger: Optional[logging.Logger] = None,
         consensus_backend: str = "cpu",
+        mesh_devices: int = 0,
     ):
         self.id = id_
         self.key = key
@@ -50,6 +51,8 @@ class Core:
         if consensus_backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown consensus backend: {consensus_backend!r}")
         self.consensus_backend = consensus_backend
+        self.mesh_devices = mesh_devices
+        self._mesh = None  # built lazily on the first mesh-backend run
         self.device_consensus_runs = 0
         self.device_consensus_fallbacks = 0
         # live-engine health: demotions (live -> one-shot falls) and
@@ -245,6 +248,26 @@ class Core:
             from ..tpu.grid import GridUnsupported
 
             self._consensus_calls += 1
+            if self.mesh_devices > 1:
+                # mesh-sharded one-shot path (--mesh-devices): the
+                # incremental live engine is single-device by design, so
+                # a mesh node re-stages per call and pays O(E) host work
+                # for multi-chip compute (BASELINE config #5's deployment
+                # shape); unsupported states fall to the CPU engine like
+                # the rest of the ladder
+                try:
+                    run_consensus_device(self.hg, mesh=self._get_mesh())
+                    self.device_consensus_runs += 1
+                    return
+                except GridUnsupported as e:
+                    self._device_down = True
+                    self.device_consensus_fallbacks += 1
+                    self.logger.warning(
+                        "mesh consensus unsupported (%s); using CPU until "
+                        "the next fast-forward", e
+                    )
+                    self.hg.run_consensus()
+                    return
             if self._consensus_calls >= self._live_retry_at:
                 from ..tpu.live import run_consensus_live
 
@@ -304,6 +327,29 @@ class Core:
                     "next fast-forward", e
                 )
         self.hg.run_consensus()
+
+    def _get_mesh(self):
+        """The node's device mesh (mesh_devices chips on one axis), built
+        once. Raises GridUnsupported when the platform has fewer devices —
+        the caller's ladder then runs the CPU engine instead of crashing
+        the node."""
+        if self._mesh is None:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            from ..tpu.grid import GridUnsupported
+
+            devs = jax.devices()
+            if len(devs) < self.mesh_devices:
+                raise GridUnsupported(
+                    f"mesh needs {self.mesh_devices} devices, platform has "
+                    f"{len(devs)}"
+                )
+            self._mesh = Mesh(
+                np.array(devs[: self.mesh_devices]), ("shard",)
+            )
+        return self._mesh
 
     def _drop_live_engine(self) -> None:
         eng = getattr(self.hg, "_live_device_engine", None)

@@ -72,6 +72,7 @@ class Node(NodeStateMachine):
         self.core = Core(
             id_, key, pmap, store, self.commit_ch, conf.logger,
             consensus_backend=conf.consensus_backend,
+            mesh_devices=getattr(conf, "mesh_devices", 0),
         )
         self.core_lock = threading.Lock()
         self.selector_lock = threading.Lock()
@@ -581,8 +582,11 @@ class Node(NodeStateMachine):
         return {
             "device_dispatches": str(eng.dispatches),
             "device_dispatch_ms_avg": f"{eng.dispatch_seconds / max(eng.dispatches, 1) * 1e3:.2f}",
+            # under the pipelined discipline this measures only the
+            # BLOCKING wait (results normally land during gossip)
             "device_fetch_ms_avg": f"{eng.fetch_seconds / calls * 1e3:.2f}",
             "device_rebases": str(eng.rebases),
+            "device_fetch_pipelined": str(eng.async_fetch).lower(),
         }
 
     def log_stats(self) -> None:

@@ -433,7 +433,7 @@ def admissible_receptions(hg, round_infos, proposed) -> bool:
     return True
 
 
-def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
+def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
     """Full five-pass pipeline with passes 1-3 on device.
 
     Equivalent to Hashgraph.run_consensus() on a freshly-inserted DAG:
@@ -441,8 +441,9 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
     received back into the store -> host ProcessDecidedRounds +
     ProcessSigPool (unchanged, so blocks come out byte-identical). Base
     grids ride the round-frontier kernel; post-reset states use the
-    level scan.
-    """
+    level scan. With `mesh` (a jax.sharding.Mesh), both pipelines run
+    sharded over its devices (babble_tpu/tpu/sharded.py) — the product
+    path behind node.Config.mesh_devices."""
     from ..common import StoreErr, StoreErrType, is_store_err
     from ..hashgraph import RoundInfo, PendingRound
 
@@ -451,7 +452,14 @@ def run_consensus_device(hg, d_max: Optional[int] = None) -> None:
         hg.process_decided_rounds()
         hg.process_sig_pool()
         return
-    if _frontier_safe(grid):
+    if mesh is not None:
+        from .sharded import sharded_frontier_passes, sharded_run_passes
+
+        if _frontier_safe(grid):
+            res = sharded_frontier_passes(mesh, grid)
+        else:
+            res = sharded_run_passes(mesh, grid)
+    elif _frontier_safe(grid):
         res = run_frontier_passes(grid, d_max=d_max)
     else:
         res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)

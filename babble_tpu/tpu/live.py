@@ -99,6 +99,13 @@ class LiveDeviceEngine:
         self.dispatch_seconds = 0.0
         self.fetch_seconds = 0.0
         self.consensus_calls = 0
+        # pipelined-fetch discipline (VERDICT r3 #2): flips on when the
+        # measured blocking fetch is consistently expensive (tunneled
+        # device); inflight = (_AsyncFetch, snapshot) of the dispatch
+        # whose results the NEXT consensus call integrates
+        self.async_fetch = ENGINE_DEFAULTS.get("async_fetch") is True
+        self.inflight: Optional[tuple] = None
+        self._slow_fetches = 0
         self.state: IncState = init_state(self.n, self.e_cap, self.r_cap)
         self.row_of: Dict[str, int] = {}
         self.hashes: List[str] = []
@@ -117,6 +124,7 @@ class LiveDeviceEngine:
     def detach(self) -> None:
         if getattr(self.hg, "insert_listener", None) is self._on_insert:
             self.hg.insert_listener = None
+        self.inflight = None  # results of a dropped engine are never stamped
 
     # -- construction ------------------------------------------------------
 
@@ -666,39 +674,188 @@ def run_consensus_live(hg) -> None:
     """Incremental device consensus for a live node: advance the persistent
     state by the events inserted since the last call, then write decisions
     back and run the host passes (mirrors engine.run_consensus_device's
-    write-back, restricted to new/undetermined work)."""
+    write-back, restricted to new/undetermined work).
 
-    from ..common import StoreErr, StoreErrType, is_store_err
-    from ..hashgraph import PendingRound, RoundInfo
+    Two fetch disciplines (VERDICT r3 #2 — the 150 ms tunnel fetch must
+    not serialize gossip):
 
+    - synchronous (default): dispatch, fetch, integrate, all in this call.
+      Correct everywhere and cheapest when the device is colocated (the
+      CPU-mesh test platform measures sub-ms fetches).
+    - pipelined (self-activating): when the measured blocking fetch is
+      expensive (a tunneled device; threshold ASYNC_FETCH_MIN_S over 3
+      consecutive calls), the fetch moves OFF the consensus critical
+      path: each call integrates the PREVIOUS dispatch's results (already
+      resident host-side via a background reader thread) and launches a
+      new dispatch whose transfer overlaps the next gossip interval.
+      Decisions lag one sync — pure timing, not content: rounds, fame,
+      and receptions are DAG facts, so block bodies stay byte-identical
+      (pinned by the strict joiner differentials), they just seal one
+      call later. The write-back validation gates run unchanged at
+      integration time against a dispatch-time snapshot of the row
+      mapping (rebases build fresh containers, so snapshots are O(1)
+      references).
+    """
     eng: Optional[LiveDeviceEngine] = getattr(hg, "_live_device_engine", None)
     if eng is None:
         eng = LiveDeviceEngine(hg)
         hg._live_device_engine = eng
         # the bootstrap replayed the whole pre-existing DAG on device; its
-        # rows still need the host write-back below
+        # rows still need the host write-back — the attach call is always
+        # synchronous so the node leaves it with a fully written store
         new_rows = list(range(len(eng.hashes)))
         new_rows.extend(eng.advance())
+        _run_sync(hg, eng, new_rows)
+        return
+    if eng.async_fetch:
+        _run_pipelined(hg, eng)
     else:
-        new_rows = eng.advance()
-    st = eng.state
+        _run_sync(hg, eng, eng.advance())
 
-    # ONE packed transfer of everything the write-back needs — per-array
-    # fetches each pay a full host<->device round trip
+
+# blocking-fetch cost that flips an engine to the pipelined discipline
+# (3 consecutive calls over the threshold); ENGINE_DEFAULTS["async_fetch"]
+# forces True/False for tests
+ASYNC_FETCH_MIN_S = 0.010
+
+
+class _AsyncFetch:
+    """Background device->host reader for one dispatch's packed results."""
+
+    def __init__(self, device_array):
+        import threading
+
+        self.done = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+        threading.Thread(
+            target=self._run, args=(device_array,), name="live-fetch",
+            daemon=True,
+        ).start()
+
+    def _run(self, device_array) -> None:
+        try:
+            self.value = jax.device_get(device_array)
+        except BaseException as e:  # noqa: BLE001 — surfaced in result()
+            self.error = e
+        finally:
+            self.done.set()
+
+    def result(self):
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+def _snapshot(eng: LiveDeviceEngine, new_rows: List[int]) -> dict:
+    """Dispatch-time view the integration needs: row mapping references
+    (hashes/row_of are replaced, never mutated, by rebases), the fetch
+    window, the round base, and the insertion high-water mark that
+    separates 'inserted after this dispatch' from 'lost by staging'."""
+    count = len(eng.hashes)
+    return dict(
+        new_rows=new_rows,
+        hashes=eng.hashes,
+        row_of=eng.row_of,
+        count=count,
+        lo=max(count - eng.e_win, 0),
+        base=eng.round_base,
+        topo_hi=eng.hg.topological_index,
+    )
+
+
+def _dispatch(eng: LiveDeviceEngine, new_rows: List[int]):
+    """Launch the packed-results program for the current device state.
+    Returns (device_array, snapshot); does NOT block on the transfer."""
+    snap = _snapshot(eng, new_rows)
+    packed = _pack_results(
+        eng.state, jnp_int32(snap["lo"]), eng.e_win, eng.r_cap, eng.n
+    )
+    return packed, snap
+
+
+def _run_sync(hg, eng: LiveDeviceEngine, new_rows: List[int]) -> None:
+    """Dispatch + blocking fetch + integrate, all under the caller's core
+    lock (the original discipline)."""
     import time as _time
 
-    count = len(eng.hashes)
-    lo = max(count - eng.e_win, 0)
+    packed_dev, snap = _dispatch(eng, new_rows)
     t0 = _time.perf_counter()
-    packed = jax.device_get(
-        _pack_results(st, jnp_int32(lo), eng.e_win, eng.r_cap, eng.n)
-    )
-    eng.fetch_seconds += _time.perf_counter() - t0
+    packed = jax.device_get(packed_dev)
+    dt = _time.perf_counter() - t0
+    eng.fetch_seconds += dt
     eng.consensus_calls += 1
+
+    last_round_rel = _integrate(hg, eng, packed, snap)
+    hg.process_decided_rounds()
+    hg.process_sig_pool()
+    _manage_capacity(eng, last_round_rel)
+
+    # self-activation of the pipelined discipline on consistently slow
+    # fetches (tunneled device); ENGINE_DEFAULTS["async_fetch"] pins it
+    forced = ENGINE_DEFAULTS.get("async_fetch")
+    if forced is False:
+        return
+    if dt > ASYNC_FETCH_MIN_S:
+        eng._slow_fetches += 1
+    else:
+        eng._slow_fetches = 0
+    if forced is True or eng._slow_fetches >= 3:
+        eng.async_fetch = True
+
+
+def _run_pipelined(hg, eng: LiveDeviceEngine) -> None:
+    """Integrate the previous dispatch, then launch a new one whose
+    transfer rides the gossip interval instead of the core lock."""
+    import time as _time
+
+    if eng.inflight is not None:
+        fetch, snap = eng.inflight
+        eng.inflight = None
+        t0 = _time.perf_counter()
+        packed = fetch.result()  # normally already resident
+        eng.fetch_seconds += _time.perf_counter() - t0
+        eng.consensus_calls += 1
+        last_round_rel = _integrate(hg, eng, packed, snap)
+        # capacity BEFORE the next dispatch: a rebase must never run with
+        # a dispatch in flight (it reads store rounds the integration just
+        # wrote, and the next dispatch must see the rebased state)
+        _manage_capacity(eng, last_round_rel)
+
+    new_rows = eng.advance()
+    if new_rows:
+        packed_dev, snap = _dispatch(eng, new_rows)
+        eng.inflight = (_AsyncFetch(packed_dev), snap)
+
+    hg.process_decided_rounds()
+    hg.process_sig_pool()
+
+
+def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
+    """Write one dispatch's results into the host hashgraph, behind the
+    same validation gates as the one-shot engine. Returns the dispatch's
+    last_round (base-relative) for capacity management.
+
+    All row arithmetic uses the dispatch-time snapshot: under the
+    pipelined discipline the engine may have appended further rows since,
+    and those are simply not covered here (the next integration handles
+    them)."""
+    from ..common import StoreErr, StoreErrType, is_store_err
+    from ..hashgraph import PendingRound, RoundInfo
+
+    count, lo, base = snap["count"], snap["lo"], snap["base"]
+    if base != eng.round_base:
+        # rebases are ordered strictly between integrations; a mismatch
+        # means the discipline was violated somewhere — refuse to stamp
+        raise GridUnsupported(
+            f"integration base {base} != engine base {eng.round_base}"
+        )
     (rounds_w, lamport_w, witness_w, received_w, wtable, fame_decided,
      famous, stale, fame_lag, last_round_rel) = _unpack_results(
         packed, eng.e_win, eng.r_cap, eng.n)
-    base = eng.round_base
+    hashes = snap["hashes"]
+    new_rows = snap["new_rows"]
     rounds_w = rounds_w[: count - lo]
     lamport_w = lamport_w[: count - lo]
     witness_w = witness_w[: count - lo]
@@ -726,14 +883,14 @@ def run_consensus_live(hg) -> None:
     # engine base whose device-side round is a sentinel)
     def _fresh_rows():
         for row in new_rows:
-            if hg.store.get_event(eng.hashes[row]).round is None:
+            if hg.store.get_event(hashes[row]).round is None:
                 yield row
 
     validate_round_writeback(
         hg,
         (
             (
-                eng.hashes[row],
+                hashes[row],
                 (int(at(row, rounds_w)) + base, int(at(row, lamport_w))),
             )
             for row in _fresh_rows()
@@ -742,7 +899,7 @@ def run_consensus_live(hg) -> None:
     undetermined = set(hg.undetermined_events)
     round_infos: Dict[int, RoundInfo] = {}
     for row in new_rows:
-        h = eng.hashes[row]
+        h = hashes[row]
         ev = hg.store.get_event(h)
         if ev.round is None:
             rnum = int(at(row, rounds_w)) + base
@@ -775,8 +932,8 @@ def run_consensus_live(hg) -> None:
         # post-reset delegation, same reasoning as engine.py: fame and
         # reception decision TIMING must match the host call-for-call or
         # block composition skews between backends. Falls through to the
-        # capacity management below — the engine still windows (rebases)
-        # like any other.
+        # capacity management — the engine still windows (rebases) like
+        # any other.
         for rnum, ri in round_infos.items():
             hg.store.set_round(rnum, ri)
         hg.decide_fame()
@@ -794,7 +951,7 @@ def run_consensus_live(hg) -> None:
                 if wrow < 0:
                     continue
                 if fame_decided[sh, c]:
-                    ri.set_fame(eng.hashes[wrow], bool(famous[sh, c]))
+                    ri.set_fame(hashes[wrow], bool(famous[sh, c]))
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
     for pr in hg.pending_rounds:
@@ -804,9 +961,29 @@ def run_consensus_live(hg) -> None:
     # --- DecideRoundReceived write-back (undetermined only) ---------------
     from .engine import admissible_receptions
 
+    def _covered(h):
+        """Row for h in THIS dispatch, None if h postdates it (pipelined
+        lag: the next integration covers it), or GridUnsupported if the
+        staging genuinely lost it."""
+        row = snap["row_of"].get(h)
+        if row is not None:
+            return row
+        try:
+            ev = hg.store.get_event(h)
+        except StoreErr:
+            ev = None
+        if ev is not None and ev.topological_index >= snap["topo_hi"]:
+            return None  # inserted after this dispatch
+        # every undetermined event known at dispatch time must be modeled
+        # (the attach keeps undetermined events regardless of round);
+        # anything unmodeled means the staging walk silently lost one —
+        # demote rather than silently never receiving it (that skews
+        # block composition)
+        raise GridUnsupported(f"undetermined event unmodeled ({h[:18]}…)")
+
     def _proposed_receptions():
         for h in hg.undetermined_events:
-            row = eng.row_of.get(h)
+            row = _covered(h)
             if row is None:
                 continue
             rr = int(at(row, received_w))
@@ -817,17 +994,8 @@ def run_consensus_live(hg) -> None:
         if admissible_receptions(hg, round_infos, _proposed_receptions()):
             new_undetermined = []
             for h in hg.undetermined_events:
-                row = eng.row_of.get(h)
-                if row is None:
-                    # every undetermined event must be modeled (the attach
-                    # keeps undetermined events regardless of round);
-                    # anything unmodeled means the staging walk silently
-                    # lost one — demote rather than silently never
-                    # receiving it (that skews block composition)
-                    raise GridUnsupported(
-                        f"undetermined event unmodeled ({h[:18]}…)"
-                    )
-                rr = int(at(row, received_w))
+                row = _covered(h)
+                rr = -1 if row is None else int(at(row, received_w))
                 if rr >= 0:
                     rr += base
                     ev = hg.store.get_event(h)
@@ -853,17 +1021,18 @@ def run_consensus_live(hg) -> None:
                 hg.store.set_round(rnum, ri)
             hg.decide_round_received()
 
-    # --- host passes 4-5 --------------------------------------------------
-    hg.process_decided_rounds()
-    hg.process_sig_pool()
+    return last_round_rel
 
-    # --- capacity management ----------------------------------------------
-    # rebase BEFORE either device axis exhausts: the round axis needs
-    # headroom for fame-decision lag (~8 rounds), the event axis for the
-    # next few syncs' appends. A momentarily-stuck rebase (fame decisions
-    # lagging, so the base cannot advance yet) is tolerated while hard
-    # room remains — it is retried on every subsequent sync; only an
-    # exhausted axis escalates to the caller's fallback.
+
+def _manage_capacity(eng: LiveDeviceEngine, last_round_rel: int) -> None:
+    """Rebase BEFORE either device axis exhausts: the round axis needs
+    headroom for fame-decision lag (~8 rounds), the event axis for the
+    next few syncs' appends. A momentarily-stuck rebase (fame decisions
+    lagging, so the base cannot advance yet) is tolerated while hard
+    room remains — it is retried on every subsequent sync; only an
+    exhausted axis escalates to the caller's fallback. Under the
+    pipelined discipline last_round_rel is one dispatch old; the soft
+    margin (8 rounds) absorbs the single-sync lag."""
     soft = (
         last_round_rel >= eng.r_cap - 8
         or len(eng.hashes) >= eng.e_cap - 4 * eng.batch_cap

@@ -48,24 +48,30 @@ def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
-@functools.lru_cache(maxsize=8)
-def _fame_chunk_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
-                   super_majority: int):
-    """Build the shard_mapped fame voting chunk for a mesh (cached so
-    repeated batches reuse the compiled executable)."""
+@functools.lru_cache(maxsize=16)
+def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
+                  super_majority: int, d_bound: int):
+    """Build the shard_mapped fame voting pass for a mesh: the WHOLE
+    voting loop runs in one dispatch, early-exiting ON DEVICE via a
+    lax.while_loop whose continue-flag is a psum across the mesh
+    (VERDICT r3 #4 — the previous per-chunk host `bool(active)` fetch
+    serialized every voting chunk on host RTT; this matches the
+    single-device discipline of kernels.consensus_pipeline). `d_bound`
+    is the static safety cap on the voting offset (r_pad + 2), bucketed
+    by the caller so the cache stays small."""
     ndev = int(np.prod(mesh.devices.shape))
     # send my first row to the previous device: a left ring-shift of the
     # globally R-sharded j-aligned tensors
     perm = [(i, (i - 1) % ndev) for i in range(ndev)]
 
-    def local_chunk(last_round, d0, i_rows, wvalid, votes, decided, famous,
-                    ss_s, wv_s, coin_s):
+    def local_fame(last_round, i_rows, wvalid, votes, decided, famous,
+                   ss_s, wv_s, coin_s):
         def shift1(x):
             recv = jax.lax.ppermute(x[:1], axis, perm)
             return jnp.concatenate([x[1:], recv], axis=0)
 
         def step(carry, k):
-            votes, decided, famous, ss_s, wv_s, coin_s = carry
+            votes, decided, famous, ss_s, wv_s, coin_s, d0 = carry
             d = d0 + k
             j = i_rows + d  # absolute voter round per local row
             j_ok = j <= last_round
@@ -102,29 +108,47 @@ def _fame_chunk_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
             coin_votes = jnp.where(strong, v, coin_s[:, :, None])
             votes = jnp.where(is_coin, coin_votes, v)
             return (votes, decided, famous, shift1(ss_s), shift1(wv_s),
-                    shift1(coin_s)), None
+                    shift1(coin_s), d0), None
 
-        carry = (votes, decided, famous, ss_s, wv_s, coin_s)
-        carry, _ = jax.lax.scan(step, carry, jnp.arange(chunk))
-        votes, decided, famous, ss_s, wv_s, coin_s = carry
+        def chunk_body(carry):
+            votes, decided, famous, ss_s, wv_s, coin_s, d0, _active = carry
+            (votes, decided, famous, ss_s, wv_s, coin_s, _d), _ = (
+                jax.lax.scan(
+                    step,
+                    (votes, decided, famous, ss_s, wv_s, coin_s, d0),
+                    jnp.arange(chunk),
+                )
+            )
+            d0 = d0 + chunk
+            # does any undecided witness still have voting rounds left?
+            # psum makes the flag identical on every device, so the
+            # while_loop condition stays coherent across the mesh
+            local_active = jnp.any(
+                wvalid & ~decided & ((i_rows[:, None] + d0) <= last_round)
+            )
+            active = jax.lax.psum(local_active.astype(jnp.int32), axis) > 0
+            return (votes, decided, famous, ss_s, wv_s, coin_s, d0, active)
 
-        # does any undecided witness still have voting rounds left?
-        local_active = jnp.any(
-            wvalid & ~decided & ((i_rows[:, None] + d0 + chunk) <= last_round)
-        )
-        active = jax.lax.psum(local_active.astype(jnp.int32), axis) > 0
-        return votes, decided, famous, ss_s, wv_s, coin_s, active
+        def cond(carry):
+            d0, active = carry[-2], carry[-1]
+            return active & (d0 <= d_bound)
 
-    shp = P(axis)
+        carry = (votes, decided, famous, ss_s, wv_s, coin_s,
+                 jnp.int32(2), jnp.bool_(True))
+        carry = chunk_body(carry)  # voting always runs at least one chunk
+        carry = jax.lax.while_loop(cond, chunk_body, carry)
+        votes, decided, famous, ss_s, wv_s, coin_s, _d0, _active = carry
+        return votes, decided, famous
+
     shp2 = P(axis, None)
     shp3 = P(axis, None, None)
     rep = P()
     return jax.jit(
         jax.shard_map(
-            local_chunk,
+            local_fame,
             mesh=mesh,
-            in_specs=(rep, rep, shp, shp2, shp3, shp2, shp2, shp3, shp2, shp2),
-            out_specs=(shp3, shp2, shp2, shp3, shp2, shp2, rep),
+            in_specs=(rep, P(axis), shp2, shp3, shp2, shp2, shp3, shp2, shp2),
+            out_specs=(shp3, shp2, shp2),
         )
     )
 
@@ -199,16 +223,16 @@ def _sharded_fame_received(
     famous = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
     i_rows = jax.device_put(np.arange(r_pad, dtype=np.int32), shard_r)
 
-    fame_chunk = _fame_chunk_fn(mesh, axis, chunk, grid.n, grid.super_majority)
-    d0 = 2
-    while True:
-        votes, decided, famous, ss_s, wv_s, coin_s, active = fame_chunk(
-            last_round, np.int32(d0), i_rows, wvalid_s, votes, decided,
-            famous, ss_s, wv_s, coin_s,
-        )
-        d0 += chunk
-        if not bool(active) or d0 > r_pad + 2:
-            break
+    # one dispatch for the whole fame pass: early exit happens on device
+    # (d_bound bucketed to the padded round count so the compiled
+    # executable is reused across similarly-sized batches)
+    fame_loop = _fame_loop_fn(
+        mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2
+    )
+    votes, decided, famous = fame_loop(
+        last_round, i_rows, wvalid_s, votes, decided, famous,
+        ss_s, wv_s, coin_s,
+    )
 
     min_la, famous_count, i_ok, horizon, rounds_decided = _fame_tables(
         wtable, la, decided, famous, last_round

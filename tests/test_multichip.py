@@ -127,6 +127,35 @@ def test_dryrun_multichip_entrypoint():
     __graft_entry__.dryrun_multichip(8)
 
 
+def test_mesh_backend_node_in_cluster_byte_identical():
+    """VERDICT r3 #3: the sharded pipeline as a PRODUCT capability — a
+    full Node configured with consensus_backend=tpu + mesh_devices=8
+    participates in a live cluster over the in-memory transport and
+    commits byte-identical blocks (check_gossip), with every consensus
+    call routed through the mesh (no silent CPU fallback)."""
+    from test_device_backend import build_mixed_cluster
+    from test_node import (
+        bombard_and_wait, check_gossip, run_nodes, shutdown_nodes,
+    )
+
+    nodes, proxies, *_ = build_mixed_cluster(
+        ["cpu", "cpu", "cpu", "tpu"], sync_limit=2000, mesh_devices={3: 8},
+    )
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=3, timeout_s=300)
+        check_gossip(nodes, upto=3)
+        assert nodes[3].core.device_consensus_runs > 0, (
+            "mesh node never ran the sharded backend"
+        )
+        assert nodes[3].core.device_consensus_fallbacks == 0, (
+            "mesh node silently fell back to the CPU engine"
+        )
+        assert nodes[3].core._mesh is not None
+    finally:
+        shutdown_nodes(nodes)
+
+
 # -- driver-environment simulation (subprocess; conftest pins must NOT leak) --
 
 import os
