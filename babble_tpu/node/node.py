@@ -93,6 +93,13 @@ class Node(NodeStateMachine):
         # behind) must be operationally visible (ADVICE r3)
         self.fast_forward_bounces = 0
         self._consecutive_bounces = 0
+        # highest block index the APP has committed (proxy.commit_block
+        # returned). The hashgraph's anchor can run a full commit channel
+        # ahead of this; fast-forward serving must never anchor past it or
+        # get_snapshot fails ("snapshot N not found") and starves joiners.
+        # Single writer (the commit loop); racing readers only ever see a
+        # slightly stale floor, which is safe (they serve an older anchor).
+        self._app_committed_index = -1
 
         self.need_bootstrap = store.need_bootstrap()
         self.set_starting(True)
@@ -286,8 +293,12 @@ class Node(NodeStateMachine):
         resp_err: Optional[str] = None
         try:
             with self.core_lock:
-                # anchor + live section must come from one consistent snapshot
-                block, frame = self.core.get_anchor_block_with_frame()
+                # anchor + live section must come from one consistent
+                # snapshot, capped at the app's committed height so the
+                # get_snapshot below cannot race the async commit channel
+                block, frame = self.core.get_anchor_block_with_frame(
+                    max_index=self._app_committed_index
+                )
                 try:
                     section = self.core.hg.get_section(frame.round, block.index())
                 except Exception as se:  # noqa: BLE001 — degraded serve:
@@ -446,6 +457,20 @@ class Node(NodeStateMachine):
                 )
             with self.core_lock:
                 self.core.apply_fast_forward(*validated)
+            # serve-availability (code review r5): if the app can serve the
+            # snapshot at the anchor we just restored, raise the serving
+            # floor so this node can act as a donor before its first
+            # post-join commit. Probed rather than assumed: the reference
+            # dummy's restore does NOT record a snapshot (dummy/state.go),
+            # so a blind floor bump would re-open the get_snapshot race.
+            anchor_index = validated[0].index()
+            if anchor_index > self._app_committed_index:
+                try:
+                    self.proxy.get_snapshot(anchor_index)
+                except Exception:  # noqa: BLE001 — app keeps no snapshot here
+                    pass
+                else:
+                    self._app_committed_index = anchor_index
         except Exception as e:
             self.logger.error("fast_forward: %s", e)
             time.sleep(self.conf.heartbeat_timeout)
@@ -489,6 +514,8 @@ class Node(NodeStateMachine):
 
     def commit(self, block: Block) -> None:
         state_hash = self.proxy.commit_block(block)
+        if block.index() > self._app_committed_index:
+            self._app_committed_index = block.index()
         block.body.state_hash = state_hash
         with self.core_lock:
             sig = self.core.sign_block(block)
@@ -560,6 +587,10 @@ class Node(NodeStateMachine):
             "consensus_backend": self.core.consensus_backend,
             "device_consensus_runs": str(self.core.device_consensus_runs),
             "device_consensus_fallbacks": str(self.core.device_consensus_fallbacks),
+            # VERDICT r4 #3: the one-shot device path retries with backoff
+            # after GridUnsupported; a heal is a successful device run that
+            # cleared a standing _device_down
+            "device_heals": str(self.core.device_heals),
             # live-engine health: demotions to the one-shot path and
             # successful re-attaches (an operator watching /stats can see
             # a degraded TPU node AND see it heal)

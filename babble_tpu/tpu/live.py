@@ -749,10 +749,16 @@ class _AsyncFetch:
 
 
 def _snapshot(eng: LiveDeviceEngine, new_rows: List[int]) -> dict:
-    """Dispatch-time view the integration needs: row mapping references
-    (hashes/row_of are replaced, never mutated, by rebases), the fetch
-    window, the round base, and the insertion high-water mark that
-    separates 'inserted after this dispatch' from 'lost by staging'."""
+    """Dispatch-time view the integration needs: row mapping references,
+    the fetch window, the round base, and the insertion high-water mark
+    that separates 'inserted after this dispatch' from 'lost by staging'.
+
+    hashes/row_of are the LIVE objects — advance() appends to both in
+    place — so `count` is the consistency fence: any row >= count was
+    appended after this dispatch and must be ignored by readers of this
+    snapshot (_covered enforces it). Rebases REPLACE both objects, so a
+    snapshot taken before a rebase keeps the pre-rebase view intact
+    (ADVICE r4)."""
     count = len(eng.hashes)
     return dict(
         new_rows=new_rows,
@@ -967,6 +973,11 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
         staging genuinely lost it."""
         row = snap["row_of"].get(h)
         if row is not None:
+            if row >= snap["count"]:
+                # appended to the live row_of AFTER this dispatch (the
+                # snapshot aliases the live dict); the packed results
+                # don't model it yet — next integration covers it
+                return None
             return row
         try:
             ev = hg.store.get_event(h)

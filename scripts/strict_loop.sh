@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Loop the two strict fast-sync recovery tests (VERDICT r4 #1: done =
+# 10/10 consecutive passes). Saves per-iteration logs; on failure keeps
+# the full pytest output for the post-mortem.
+set -u
+N="${1:-10}"
+OUT="${2:-/tmp/strict_loop}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+pass=0
+for i in $(seq 1 "$N"); do
+    log="$OUT/iter_${i}.log"
+    timeout 2400 python -m pytest \
+        tests/test_device_backend.py::test_mixed_backend_fast_sync_byte_identical \
+        tests/test_device_backend.py::test_live_engine_reattaches_after_fast_sync \
+        -q -p no:faulthandler --log-level=INFO > "$log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        pass=$((pass + 1))
+        echo "iter $i: PASS ($pass/$i)" | tee -a "$OUT/summary.txt"
+        tail -1 "$log" >> "$OUT/summary.txt"
+    else
+        echo "iter $i: FAIL rc=$rc — log kept at $log" | tee -a "$OUT/summary.txt"
+        cp "$log" "$OUT/FAIL_iter_${i}.log"
+    fi
+done
+echo "DONE: $pass/$N passed" | tee -a "$OUT/summary.txt"
