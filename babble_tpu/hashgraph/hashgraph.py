@@ -121,6 +121,9 @@ class Hashgraph:
         # _block_proof_count
         self._proof_count_cache: Dict[tuple, int] = {}
         self.reset_floor: Optional[int] = None
+        # index of the block this hashgraph was last reset() from (-1 if
+        # never reset): the anchor-serving walk cannot build frames below it
+        self._reset_anchor_index: int = -1
         # optional hook: called as (event, fd_writes) after every insert —
         # the incremental device engine's delta feed (babble_tpu/tpu/live.py)
         self.insert_listener = None
@@ -825,7 +828,13 @@ class Hashgraph:
         idx = self.anchor_block
         if max_index is not None and max_index < idx:
             idx = max_index
-        while idx >= 0:
+        # bounded walk (code review r5): blocks below our own reset anchor
+        # have no rebuildable frames (reset cleared their rounds), and a
+        # donor whose chain is healthy finds a signed anchor within a few
+        # steps — so don't let a pathological store turn every joiner
+        # request into an O(cache) scan under core_lock
+        floor = max(self._reset_anchor_index, idx - 128)
+        while idx >= floor:
             try:
                 block = self.store.get_block(idx)
             except StoreErr:
@@ -878,10 +887,31 @@ class Hashgraph:
         # anchor must stay servable past cache_size newer rounds.
         self.store.set_frame(frame)
         self._reset_frame = frame
+        self._reset_anchor_index = block.index()
         self._set_last_consensus_round(block.round_received())
 
         for ev in frame.events:
             self.insert_event(ev, False)
+
+        # Seed the last-consensus-event baseline recoverable from the frame
+        # itself: frame events are the events RECEIVED at the anchor round,
+        # and round-received is monotone along each self-parent chain, so a
+        # participant's highest-indexed frame event IS its last consensus
+        # event as of the anchor. Without this, the next frame this node
+        # builds constructs roots for participants quiet since the anchor
+        # from the anchor ROOT (their first-received event) instead of
+        # their last consensus event — a divergent FrameHash, hence a
+        # byte-divergent block (the round-5 root cause of the mixed-backend
+        # fast-sync divergence; the section path's consensus_baseline
+        # refines this for participants quiet since BEFORE the anchor,
+        # whose correct roots the frame's root_map already carries).
+        last_per_creator: Dict[str, Event] = {}
+        for ev in frame.events:
+            cur = last_per_creator.get(ev.creator())
+            if cur is None or ev.index() > cur.index():
+                last_per_creator[ev.creator()] = ev
+        for p, ev in last_per_creator.items():
+            self.store.seed_last_consensus_event(p, ev.hex())
 
     # ------------------------------------------------------------------
     # fast-sync live section (beyond the reference — see section.py)

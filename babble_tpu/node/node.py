@@ -5,10 +5,11 @@ onto daemon threads:
 
 - the state-machine loop (`run`): Babbling -> babble(), CatchingUp ->
   fast_forward(), Shutdown -> return;
-- the background dispatcher (`_do_background_work`): a unified work queue
-  fed by forwarder threads draining the transport consumer, the app submit
-  queue and the consensus commit queue — the Python rendition of Go's
-  select over four channels (reference: src/node/node.go:144-174);
+- per-source worker threads (`_serve_source`) draining the transport
+  consumer, the app submit queue and the consensus commit queue — a
+  deliberate unbundling of Go's single select loop (reference:
+  src/node/node.go:144-174) so RPC dispatch never queues behind a commit
+  that is waiting out a slow consensus pass under core_lock;
 - the control timer driving gossip ticks.
 
 `core_lock` serializes all Core/Hashgraph access, exactly like the
@@ -105,7 +106,6 @@ class Node(NodeStateMachine):
         self.set_starting(True)
         self.set_state(NodeState.BABBLING)
 
-        self._work: "queue.Queue[Tuple[str, object]]" = queue.Queue()
         self._run_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -128,19 +128,26 @@ class Node(NodeStateMachine):
         self.start_time = time.monotonic()
         self.control_timer.run()
 
+        # One worker per source instead of a merged queue behind a single
+        # dispatcher (deliberate deviation from the reference's select loop,
+        # node.go:144-174, which serializes all four channels on one
+        # goroutine): block commits and transaction inserts take core_lock
+        # inline, so a merged queue parks incoming RPCs behind a commit
+        # that is itself waiting out a slow consensus pass — the node stops
+        # answering gossip for seconds and the cluster reads it as down
+        # (the round-1..4 "node wedge"). Per-source workers keep RPC
+        # dispatch independent of the commit path while preserving the
+        # orderings that matter: commits apply in block order, submissions
+        # in arrival order.
         for src, tag in (
             (self.net_ch, "rpc"),
             (self.submit_ch, "tx"),
             (self.commit_ch, "block"),
         ):
             threading.Thread(
-                target=self._forward, args=(src, tag), daemon=True,
-                name=f"node-{self.id}-fwd-{tag}",
+                target=self._serve_source, args=(src, tag), daemon=True,
+                name=f"node-{self.id}-{tag}",
             ).start()
-        threading.Thread(
-            target=self._do_background_work, daemon=True,
-            name=f"node-{self.id}-background",
-        ).start()
 
         while True:
             state = self.get_state()
@@ -151,18 +158,10 @@ class Node(NodeStateMachine):
             elif state == NodeState.SHUTDOWN:
                 return
 
-    def _forward(self, src: "queue.Queue", tag: str) -> None:
+    def _serve_source(self, src: "queue.Queue", tag: str) -> None:
         while not self.shutdown_event.is_set():
             try:
                 item = src.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            self._work.put((tag, item))
-
-    def _do_background_work(self) -> None:
-        while not self.shutdown_event.is_set():
-            try:
-                tag, item = self._work.get(timeout=0.1)
             except queue.Empty:
                 continue
             if tag == "rpc":

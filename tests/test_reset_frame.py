@@ -1,12 +1,25 @@
-"""Unit-level Reset-from-Frame test: rebuild a hashgraph mid-history from
+"""Unit-level Reset-from-Frame tests: rebuild a hashgraph mid-history from
 a (block, frame) checkpoint and verify it reproduces the original's
 rounds, witnesses and consensus — then keep going with the remaining
 events (reference: src/hashgraph/hashgraph_test.go:1711-1907
-TestResetFromFrame)."""
+TestResetFromFrame, :2344-2530 TestFunkyHashgraphReset, :2656-2816
+TestSparseHashgraphReset).
+
+The every-block reset tests add a stronger oracle than the reference's
+witness comparison: every block the reset graph re-derives above its
+anchor must be BYTE-IDENTICAL to the original's (the re-decide path is
+exactly what a fast-sync joiner runs, so a divergence here is the unit
+form of the cluster-level block-body divergence)."""
 
 from babble_tpu.hashgraph import Event, Frame, Hashgraph, InmemStore
 
-from dsl import CACHE_SIZE, get_name, init_consensus_hashgraph
+from dsl import (
+    CACHE_SIZE,
+    get_name,
+    init_consensus_hashgraph,
+    init_funky_hashgraph,
+    init_sparse_hashgraph,
+)
 
 
 def test_reset_from_frame():
@@ -79,3 +92,83 @@ def test_reset_from_frame():
         assert sorted(h.store.get_round(r).witnesses()) == sorted(
             h2.store.get_round(r).witnesses()
         ), f"round {r} witnesses diverged after reset"
+
+
+def _wire_diff(h, h2):
+    """Every event of `h` above `h2`'s per-participant heads, in
+    topological order as wire events (the reference's getDiff +
+    ToWire loop, hashgraph_test.go:2384-2405)."""
+    known = h2.store.known_events()
+    diff = []
+    for peer in h.participants.to_peer_slice():
+        for eh in h.store.participant_events(peer.pub_key_hex, known[peer.id]):
+            diff.append(h.store.get_event(eh))
+    diff.sort(key=lambda ev: ev.topological_index)
+    return [ev.to_wire() for ev in diff]
+
+
+def _reset_from_every_block(builder, n_blocks):
+    """Reset a fresh hashgraph from each of the first `n_blocks` blocks'
+    (block, frame) checkpoints, catch it up through the wire-event diff,
+    and require (a) witness sets to converge per round and (b) every
+    re-derived block above the anchor to be byte-identical."""
+    h, index, _ = builder()
+    h.divide_rounds()
+    h.decide_fame()
+    h.decide_round_received()
+    h.process_decided_rounds()
+    assert h.store.last_block_index() >= n_blocks - 1, (
+        "fixture decided fewer blocks than the test resets from"
+    )
+
+    for bi in range(n_blocks):
+        block = h.store.get_block(bi)
+        frame = h.get_frame(block.round_received())
+        # the JSON round-trip clears computed per-event metadata, which
+        # the reset graph must recompute from the frame roots
+        frame2 = Frame.from_json(frame.to_json())
+        h2 = Hashgraph(h.participants, InmemStore(h.participants, CACHE_SIZE))
+        h2_blocks = []
+        h2.commit_callback = h2_blocks.append
+        h2.reset(block, frame2)
+
+        for wev in _wire_diff(h, h2):
+            ev = h2.read_wire_info(wev)
+            h2.insert_event(ev, False)
+
+        h2.divide_rounds()
+        h2.decide_fame()
+        h2.decide_round_received()
+        h2.process_decided_rounds()
+
+        for r in range(block.round_received() + 1, h2.store.last_round() + 1):
+            try:
+                expected = sorted(h.store.get_round(r).witnesses())
+            except Exception:
+                continue
+            assert expected == sorted(h2.store.get_round(r).witnesses()), (
+                f"reset from block {bi}: round {r} witnesses diverged"
+            )
+
+        # the re-derived chain above the anchor must be the original's,
+        # byte for byte (the fast-sync joiner safety oracle)
+        for b2 in h2_blocks:
+            orig = h.store.get_block(b2.index())
+            assert b2.body.marshal() == orig.body.marshal(), (
+                f"reset from block {bi}: block {b2.index()} body diverged"
+            )
+        assert h2.store.last_block_index() >= h.store.last_block_index(), (
+            f"reset from block {bi}: fewer blocks decided than the original"
+        )
+
+
+def test_funky_reset_every_block():
+    """reference: hashgraph_test.go:2344-2530 — the adversarial coin-round
+    topology, reset from blocks 0, 1 and 2."""
+    _reset_from_every_block(lambda: init_funky_hashgraph(full=True), 3)
+
+
+def test_sparse_reset_every_block():
+    """reference: hashgraph_test.go:2656-2816 — sparse witness sets,
+    reset from blocks 0, 1 and 2."""
+    _reset_from_every_block(init_sparse_hashgraph, 3)
