@@ -156,6 +156,65 @@ def main():
     int(np.asarray(acc))
     elapsed = (time.perf_counter() - start) / iters
 
+    # optional phase breakdown (VERDICT r4 #6): time the walk / fame /
+    # received stages as separate programs with the accumulate-then-fetch
+    # discipline (per-fetch tunnel RTT ~200 ms would otherwise dominate)
+    if os.environ.get("SCALE_PHASES"):
+        from babble_tpu.tpu.frontier import frontier_rounds
+        from babble_tpu.tpu.kernels import _decide_fame, _decide_round_received
+
+        fame_jit = jax.jit(
+            _decide_fame,
+            static_argnames=("super_majority", "n_participants", "d_cap"),
+        )
+        recv_jit = jax.jit(_decide_round_received)
+
+        def walk():
+            return frontier_rounds(
+                inv, dev["rows_by"], dev["creator"], dev["index"],
+                dev["sp_index"], dev["first_descendants"],
+                super_majority=grid.super_majority, r_cap=r_fame,
+                la=dev["last_ancestors"],
+            )
+
+        fr = walk()
+
+        def fame():
+            return fame_jit(
+                fr.witness_table, dev["last_ancestors"],
+                dev["first_descendants"], dev["index"], dev["coin_bit"],
+                fr.last_round, super_majority=grid.super_majority,
+                n_participants=grid.n, d_cap=r_fame + 2,
+            )
+
+        fm = fame()
+
+        def received():
+            return recv_jit(
+                fr.witness_table, dev["last_ancestors"], dev["index"],
+                dev["creator"], fr.rounds, fm.decided, fm.famous,
+                fm.rounds_decided, fr.last_round,
+            )
+
+        phases = {
+            "walk": lambda: walk().last_round,
+            "fame": lambda: jnp.sum(fame().rounds_decided),
+            "received": lambda: jnp.sum(received()),
+        }
+        report = {}
+        for name, fn in phases.items():
+            acc = jnp.int32(0)
+            for _ in range(5):
+                acc = acc + fn()
+            int(np.asarray(acc))  # warm
+            t0 = time.perf_counter()
+            acc = jnp.int32(0)
+            for _ in range(iters):
+                acc = acc + fn()
+            int(np.asarray(acc))
+            report[name] = round((time.perf_counter() - t0) / iters * 1e3, 2)
+        print(json.dumps({"phase_ms": report, "config": LABEL, "r_fame": r_fame}))
+
     # bit-exactness gate vs the level-scan engine path
     res = run_passes(grid, adaptive_r=True)
     np.testing.assert_array_equal(np.asarray(out.rounds), res.rounds)

@@ -1,0 +1,28 @@
+"""babble-tpu: a TPU-native BFT consensus framework.
+
+A from-scratch rebuild of the capabilities of Babble (hashgraph consensus
+middleware, reference: /root/reference) designed TPU-first: the host runtime
+(gossip, DAG storage, blockchain projection, app proxy) is Python threads,
+and the virtual-voting consensus core is expressed as dense batched array
+kernels executed via JAX/XLA, swappable with a scalar CPU engine behind the
+same `Hashgraph` API (reference: src/hashgraph/hashgraph.go).
+
+Top-level surface: `Babble` (composition root + embedding API,
+reference: src/babble/babble.go + src/mobile/node.go), `BabbleConfig`,
+`keygen`, and `Service` (HTTP status endpoint).
+"""
+
+from .version import version as __version__  # noqa: F401
+
+# the composition root pulls in every subsystem; import lazily so that
+# `import babble_tpu.tpu.kernels` (device-only users) stays light
+def __getattr__(name):
+    if name in ("Babble", "BabbleConfig", "keygen", "default_data_dir"):
+        from . import babble as _babble
+
+        return getattr(_babble, name)
+    if name == "Service":
+        from .service import Service
+
+        return Service
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,7 @@
+"""`python -m babble_tpu` — the CLI entry point."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,192 @@
+"""Composition root: wires peers, store, transport, key, node and service
+into one runnable engine (reference: src/babble/babble.go:16-231).
+
+Also the mobile-style embedding surface (reference: src/mobile/node.go:22-96):
+`Babble` exposes run/submit_tx/shutdown plus an optional commit handler
+callback, so an application can embed a node without touching the lower
+layers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .crypto import PemKey, generate_key, pub_key_bytes
+from .hashgraph import Block, InmemStore, SQLiteStore
+from .net import TCPTransport
+from .node import Config as NodeConfig
+from .node import Node
+from .peers import JSONPeers
+from .proxy import AppProxy
+from .service import Service
+
+
+def default_data_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".babble")
+
+
+@dataclass
+class BabbleConfig:
+    """Engine-level configuration (reference: src/babble/babble_config.go:15-51).
+
+    `node` nests the runtime knobs (heartbeat, timeouts, cache, sync limit,
+    consensus backend); the fields here cover composition: where the data
+    lives, what to bind, which store, which proxy."""
+
+    data_dir: str = field(default_factory=default_data_dir)
+    bind_addr: str = ":1337"
+    service_addr: str = ""  # "" = no HTTP service
+    # allow /debug/* (stack dumps, sampling profiler) from non-loopback
+    # clients; off by default — the profiler can hold a GIL-contending
+    # sampling loop for up to 60s per request
+    service_remote_debug: bool = False
+    max_pool: int = 2
+    store: bool = False  # False = in-memory, True = sqlite under data_dir
+    log_level: str = "info"
+    load_peers: bool = True
+    proxy: Optional[AppProxy] = None
+    # ec.EllipticCurvePrivateKey; loaded from <data_dir>/priv_key.pem if None
+    key: Optional[object] = None
+    node: NodeConfig = field(default_factory=NodeConfig)
+
+    def db_path(self) -> str:
+        """reference: BabbleConfig.BadgerDir (babble_config.go:49-51)."""
+        return os.path.join(self.data_dir, "babble.db")
+
+
+class Babble:
+    """One consensus node, fully wired (reference: src/babble/babble.go)."""
+
+    def __init__(self, config: BabbleConfig):
+        self.config = config
+        self.peers = None
+        self.store = None
+        self.trans = None
+        self.node: Optional[Node] = None
+        self.service: Optional[Service] = None
+        self.logger = config.node.logger or logging.getLogger("babble")
+        self._commit_handler: Optional[Callable[[Block], bytes]] = None
+
+    # -- init sequence (reference: babble.go:171-201) -------------------
+
+    def init(self) -> None:
+        self._init_peers()
+        self._init_store()
+        self._init_transport()
+        self._init_key()
+        self._init_node()
+        self._init_service()
+
+    def _init_peers(self) -> None:
+        if not self.config.load_peers:
+            if self.peers is None:
+                raise ValueError("did not load peers but none defined")
+            return
+        store = JSONPeers(self.config.data_dir)
+        try:
+            peers = store.peers()
+        except FileNotFoundError:
+            peers = None
+        if peers is None or len(peers.to_peer_slice()) == 0:
+            raise ValueError(f"peers.json not found in {self.config.data_dir}")
+        self.peers = peers
+
+    def _init_store(self) -> None:
+        if self.config.store:
+            self.store = SQLiteStore.load_or_create(
+                self.peers, self.config.node.cache_size, self.config.db_path()
+            )
+        else:
+            self.store = InmemStore(self.peers, self.config.node.cache_size)
+
+    def _init_transport(self) -> None:
+        self.trans = TCPTransport(
+            self.config.bind_addr,
+            max_pool=self.config.max_pool,
+            timeout=self.config.node.tcp_timeout,
+        )
+
+    def _init_key(self) -> None:
+        if self.config.key is not None:
+            return
+        self.config.key = PemKey(self.config.data_dir).read_key()
+
+    def _init_node(self) -> None:
+        if self.config.proxy is None:
+            raise ValueError("no proxy configured")
+        pub_hex = "0x" + pub_key_bytes(self.config.key).hex().upper()
+        peer = self.peers.by_pub_key.get(pub_hex)
+        if peer is None:
+            raise ValueError(f"node key {pub_hex[:14]}… is not in the peer set")
+        self.node = Node(
+            self.config.node,
+            peer.id,
+            self.config.key,
+            self.peers,
+            self.store,
+            self.trans,
+            self.config.proxy,
+        )
+        self.node.init()
+
+    def _init_service(self) -> None:
+        if self.config.service_addr:
+            self.service = Service(
+                self.config.service_addr, self.node, self.logger,
+                remote_debug=self.config.service_remote_debug,
+            )
+
+    # -- run (reference: babble.go:203-209) ------------------------------
+
+    def run(self) -> None:
+        """Blocking: serve HTTP (if configured) and run the node loop."""
+        if self.service is not None:
+            self.service.serve()
+        self.node.run(True)
+
+    def run_async(self) -> None:
+        if self.service is not None:
+            self.service.serve()
+        self.node.run_async(True)
+
+    # -- embedding surface (reference: src/mobile/node.go:22-96) ---------
+
+    def submit_tx(self, tx: bytes) -> None:
+        """Submit a raw transaction into consensus (mobile contract)."""
+        # the proxy owns the submit channel; push through it so ordering
+        # matches app-submitted transactions
+        self.config.proxy.submit_ch().put(bytes(tx))
+
+    def on_commit(self, handler: Callable[[Block], bytes]) -> None:
+        """Register a commit callback (mobile CommitHandler contract). Only
+        valid for proxies exposing set_commit_handler (InmemAppProxy)."""
+        set_handler = getattr(self.config.proxy, "set_commit_handler", None)
+        if set_handler is None:
+            raise ValueError("configured proxy does not support commit handlers")
+        set_handler(handler)
+
+    def shutdown(self) -> None:
+        if self.node is not None:
+            self.node.shutdown()
+        if self.service is not None:
+            self.service.shutdown()
+
+
+def keygen(data_dir: str):
+    """Generate and persist a new node key; refuses to overwrite
+    (reference: babble.go:211-231)."""
+    pem = PemKey(data_dir)
+    try:
+        pem.read_key()
+    except (FileNotFoundError, ValueError):
+        pass
+    else:
+        raise ValueError(f"another key already lives under {data_dir}")
+    key = generate_key()
+    os.makedirs(data_dir, exist_ok=True)
+    pem.write_key(key)
+    return key

@@ -1,0 +1,223 @@
+"""babble-tpu command line: `run`, `keygen`, `version`
+(reference: cmd/babble/main.go:11-15, cmd/babble/commands/run.go:28-155).
+
+Flags mirror the reference's run command; values may also come from an
+optional config file `<datadir>/babble.json` or `<datadir>/babble.toml`
+(flag > config file > default, the viper merge order of run.go:93-155).
+One addition: `--consensus-backend {cpu,tpu}` selects the host or device
+consensus engine (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from . import version as version_mod
+from .babble import Babble, BabbleConfig, default_data_dir, keygen
+from .node import Config as NodeConfig
+from .proxy import InmemDummyClient, SocketAppProxy
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+    "panic": logging.CRITICAL,
+}
+
+
+def _load_config_file(datadir: str) -> dict:
+    """`babble.{json,toml}` under the datadir (reference: run.go:129-155)."""
+    jpath = os.path.join(datadir, "babble.json")
+    if os.path.exists(jpath):
+        with open(jpath) as f:
+            return json.load(f)
+    tpath = os.path.join(datadir, "babble.toml")
+    if os.path.exists(tpath):
+        import tomllib
+
+        with open(tpath, "rb") as f:
+            return tomllib.load(f)
+    return {}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="babble-tpu", description="TPU-native hashgraph consensus node")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="Run a babble node")
+    run.add_argument("--datadir", default=default_data_dir(),
+                     help="Top-level directory for configuration and data")
+    run.add_argument("--log", default="info", choices=sorted(LOG_LEVELS),
+                     help="Log level")
+    run.add_argument("-l", "--listen", default=":1337",
+                     help="Listen IP:Port for the babble node")
+    run.add_argument("-t", "--timeout", type=float, default=1.0,
+                     help="TCP timeout in seconds")
+    run.add_argument("--max-pool", type=int, default=2,
+                     help="Connection pool size max")
+    run.add_argument("--standalone", action="store_true",
+                     help="Do not create a proxy (use the built-in dummy app)")
+    run.add_argument("-p", "--proxy-listen", default="127.0.0.1:1338",
+                     help="Listen IP:Port for the babble proxy")
+    run.add_argument("-c", "--client-connect", default="127.0.0.1:1339",
+                     help="IP:Port to connect to the client app")
+    run.add_argument("-s", "--service-listen", default="",
+                     help="Listen IP:Port for the HTTP service")
+    run.add_argument("--service-remote-debug", action="store_true",
+                     help="Allow /debug/* (profiler, stack dumps) from "
+                          "non-loopback clients")
+    run.add_argument("--store", action="store_true",
+                     help="Use persistent on-disk store instead of in-mem")
+    run.add_argument("--cache-size", type=int, default=500,
+                     help="Number of items in LRU caches")
+    run.add_argument("--heartbeat", type=float, default=1.0,
+                     help="Time between gossips in seconds")
+    run.add_argument("--sync-limit", type=int, default=100,
+                     help="Max number of events for sync")
+    run.add_argument("--consensus-backend", default="cpu", choices=("cpu", "tpu"),
+                     help="Run the five-pass pipeline on host (cpu) or device (tpu)")
+    run.add_argument("--mesh-devices", type=int, default=0,
+                     help="With --consensus-backend=tpu: shard the device "
+                          "passes over this many chips (0 = single device)")
+
+    kg = sub.add_parser("keygen", help="Create new key pair")
+    kg.add_argument("--datadir", default=default_data_dir(),
+                    help="Directory to write priv_key.pem into")
+
+    sub.add_parser("version", help="Show version info")
+    return p
+
+
+_SENTINEL = object()
+
+
+def _explicit_attrs(argv) -> set:
+    """Which run-command dests the user actually passed on the command
+    line. Detected by re-parsing with every default swapped for a
+    sentinel — argparse itself then accounts for glued short options
+    (-t5), '=' forms, and prefix abbreviations (--heart 2)."""
+    p = build_parser()
+    sub = next(
+        a for a in p._actions if isinstance(a, argparse._SubParsersAction)
+    )
+    for act in sub.choices["run"]._actions:
+        if act.dest != "help":
+            act.default = _SENTINEL
+    ns = p.parse_args(argv)
+    return {
+        k for k, v in vars(ns).items()
+        if v is not _SENTINEL and k != "command"
+    }
+
+
+def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
+    """Config-file values fill in anything the user did not pass
+    explicitly (flags win, like the reference's viper binding,
+    run.go:93-127). Explicitness is detected by argparse itself, not by
+    comparing against defaults — a flag explicitly set TO its default
+    must still beat the file."""
+    cfg = _load_config_file(args.datadir)
+    if not cfg:
+        return
+    argv = list(sys.argv[1:] if argv is None else argv)
+    explicit = _explicit_attrs(argv)
+
+    mapping = {
+        "log": "log", "listen": "listen", "timeout": "timeout",
+        "max-pool": "max_pool", "standalone": "standalone",
+        "proxy-listen": "proxy_listen", "client-connect": "client_connect",
+        "service-listen": "service_listen",
+        "service-remote-debug": "service_remote_debug", "store": "store",
+        "cache-size": "cache_size", "heartbeat": "heartbeat",
+        "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
+        "mesh-devices": "mesh_devices",
+    }
+    for file_key, attr in mapping.items():
+        if file_key in cfg and attr not in explicit:
+            setattr(args, attr, cfg[file_key])
+
+
+def run_command(args: argparse.Namespace) -> int:
+    logging.basicConfig(
+        level=LOG_LEVELS[args.log],
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    logger = logging.getLogger("babble")
+
+    if args.standalone:
+        proxy = InmemDummyClient(logger)
+    else:
+        proxy = SocketAppProxy(
+            client_addr=args.client_connect,
+            bind_addr=args.proxy_listen,
+            timeout=args.heartbeat,
+            logger=logger,
+        )
+
+    config = BabbleConfig(
+        data_dir=args.datadir,
+        bind_addr=args.listen,
+        service_addr=args.service_listen,
+        service_remote_debug=args.service_remote_debug,
+        max_pool=args.max_pool,
+        store=args.store,
+        log_level=args.log,
+        proxy=proxy,
+        node=NodeConfig(
+            heartbeat_timeout=args.heartbeat,
+            tcp_timeout=args.timeout,
+            cache_size=args.cache_size,
+            sync_limit=args.sync_limit,
+            consensus_backend=args.consensus_backend,
+            mesh_devices=args.mesh_devices,
+            logger=logger,
+        ),
+    )
+
+    engine = Babble(config)
+    try:
+        engine.init()
+    except Exception as e:  # noqa: BLE001 — startup errors go to the operator
+        logger.error("Cannot initialize engine: %s", e)
+        return 1
+    try:
+        engine.run()
+    except KeyboardInterrupt:
+        engine.shutdown()
+    return 0
+
+
+def keygen_command(args: argparse.Namespace) -> int:
+    try:
+        key = keygen(args.datadir)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    from .crypto import pub_key_bytes
+
+    print(f"Public Key: 0x{pub_key_bytes(key).hex().upper()}")
+    print(f"Key written to {os.path.join(args.datadir, 'priv_key.pem')}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "run":
+        _merge_config_file(args, argv)
+        return run_command(args)
+    if args.command == "keygen":
+        return keygen_command(args)
+    if args.command == "version":
+        print(version_mod.version)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Bounded LRU cache (reference: src/common/lru.go:11-156).
+
+Python's OrderedDict gives us the recency list for free; the optional
+eviction callback mirrors the reference API.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+
+class LRU:
+    def __init__(self, size: int, on_evict: Optional[Callable[[Any, Any], None]] = None):
+        if size <= 0:
+            raise ValueError("LRU size must be positive")
+        self.size = size
+        self.on_evict = on_evict
+        self._items: OrderedDict = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key) -> bool:
+        return key in self._items
+
+    def get(self, key):
+        """Returns (value, True) and refreshes recency, or (None, False)."""
+        try:
+            self._items.move_to_end(key)
+        except KeyError:
+            return None, False
+        return self._items[key], True
+
+    def peek(self, key):
+        """Returns (value, True) without refreshing recency."""
+        if key in self._items:
+            return self._items[key], True
+        return None, False
+
+    def add(self, key, value) -> bool:
+        """Adds a value; returns True if an eviction occurred."""
+        if key in self._items:
+            self._items.move_to_end(key)
+            self._items[key] = value
+            return False
+        self._items[key] = value
+        if len(self._items) > self.size:
+            old_key, old_val = self._items.popitem(last=False)
+            if self.on_evict is not None:
+                self.on_evict(old_key, old_val)
+            return True
+        return False
+
+    def remove(self, key) -> bool:
+        if key in self._items:
+            del self._items[key]
+            return True
+        return False
+
+    def keys(self):
+        """Keys oldest-to-newest."""
+        return list(self._items.keys())
+
+    def purge(self) -> None:
+        if self.on_evict is not None:
+            for k, v in self._items.items():
+                self.on_evict(k, v)
+        self._items.clear()

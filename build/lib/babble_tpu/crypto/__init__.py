@@ -1,0 +1,33 @@
+from .keys import (
+    generate_key,
+    pub_key_bytes,
+    pub_key_from_bytes,
+    sign,
+    verify,
+    encode_signature,
+    decode_signature,
+    key_to_pem,
+    key_from_pem,
+    to_pem_dump,
+    PemDump,
+    PemKey,
+)
+from .hashing import sha256, simple_hash_from_two_hashes, simple_hash_from_hashes
+
+__all__ = [
+    "generate_key",
+    "pub_key_bytes",
+    "pub_key_from_bytes",
+    "sign",
+    "verify",
+    "encode_signature",
+    "decode_signature",
+    "key_to_pem",
+    "key_from_pem",
+    "to_pem_dump",
+    "PemDump",
+    "PemKey",
+    "sha256",
+    "simple_hash_from_two_hashes",
+    "simple_hash_from_hashes",
+]

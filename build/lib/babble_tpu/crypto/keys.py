@@ -1,0 +1,148 @@
+"""ECDSA P-256 keys, signatures, and PEM I/O.
+
+Mirrors the reference's choices (reference: src/crypto/utils.go:12-47,
+src/crypto/pem_key.go:19-108): NIST P-256, uncompressed-point public keys
+(0x04 || X || Y), signatures encoded as "r|s" in base-36 text (the r value
+doubles as the Lamport tie-breaker in consensus ordering), and SEC1
+"EC PRIVATE KEY" PEM files.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+    Prehashed,
+)
+from cryptography.exceptions import InvalidSignature
+
+_CURVE = ec.SECP256R1()
+_PREHASHED = Prehashed(hashes.SHA256())
+# RFC 6979 deterministic nonces: same key + same digest => same (r, s).
+# The reference signs with randomized nonces (src/crypto/utils.go:29-37),
+# which standard verification accepts either way — but determinism is a
+# strictly stronger contract this framework relies on: the signature's r
+# value is the Lamport tie-breaker in consensus ordering (event.py), so a
+# validator that re-signs an identical event body (crash replay, backend
+# differential, process restart) must reproduce the same bytes or two
+# otherwise bit-equal nodes order frames differently.
+try:
+    _SIGN_ALG = ec.ECDSA(_PREHASHED, deterministic_signing=True)
+except TypeError as _e:  # cryptography < 42 lacks the keyword
+    raise ImportError(
+        "babble-tpu requires cryptography>=42.0 for RFC 6979 deterministic "
+        "ECDSA (consensus ordering tie-breaks on signature bytes)"
+    ) from _e
+
+PEM_KEY_FILE = "priv_key.pem"
+
+_B36_ALPHABET = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def _int_to_base36(n: int) -> str:
+    if n == 0:
+        return "0"
+    neg = n < 0
+    n = abs(n)
+    out = []
+    while n:
+        n, rem = divmod(n, 36)
+        out.append(_B36_ALPHABET[rem])
+    if neg:
+        out.append("-")
+    return "".join(reversed(out))
+
+
+def generate_key() -> ec.EllipticCurvePrivateKey:
+    return ec.generate_private_key(_CURVE)
+
+
+def pub_key_bytes(key) -> bytes:
+    """Uncompressed point encoding of the public key (65 bytes)."""
+    pub = key.public_key() if isinstance(key, ec.EllipticCurvePrivateKey) else key
+    return pub.public_bytes(
+        serialization.Encoding.X962,
+        serialization.PublicFormat.UncompressedPoint,
+    )
+
+
+def pub_key_from_bytes(data: bytes) -> Optional[ec.EllipticCurvePublicKey]:
+    if not data:
+        return None
+    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, data)
+
+
+def sign(key: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
+    """Sign a precomputed SHA-256 digest; returns (r, s). Deterministic
+    (RFC 6979): signing the same digest with the same key reproduces the
+    same signature bytes."""
+    der = key.sign(digest, _SIGN_ALG)
+    return decode_dss_signature(der)
+
+
+def verify(pub: ec.EllipticCurvePublicKey, digest: bytes, r: int, s: int) -> bool:
+    if pub is None:
+        return False
+    try:
+        pub.verify(encode_dss_signature(r, s), digest, ec.ECDSA(_PREHASHED))
+        return True
+    except InvalidSignature:
+        return False
+    except ValueError:
+        return False
+
+
+def encode_signature(r: int, s: int) -> str:
+    return f"{_int_to_base36(r)}|{_int_to_base36(s)}"
+
+
+def decode_signature(sig: str) -> Tuple[int, int]:
+    values = sig.split("|")
+    if len(values) != 2:
+        raise ValueError(f"wrong number of values in signature: got {len(values)}, want 2")
+    return int(values[0], 36), int(values[1], 36)
+
+
+def key_to_pem(key: ec.EllipticCurvePrivateKey) -> str:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,  # SEC1 "EC PRIVATE KEY"
+        serialization.NoEncryption(),
+    ).decode("ascii")
+
+
+def key_from_pem(data: bytes) -> ec.EllipticCurvePrivateKey:
+    return serialization.load_pem_private_key(data, password=None)
+
+
+@dataclass
+class PemDump:
+    public_key: str
+    private_key: str
+
+
+def to_pem_dump(key: ec.EllipticCurvePrivateKey) -> PemDump:
+    pub_hex = "0x" + pub_key_bytes(key).hex().upper()
+    return PemDump(public_key=pub_hex, private_key=key_to_pem(key))
+
+
+class PemKey:
+    """Private-key file in a data directory (reference: src/crypto/pem_key.go)."""
+
+    def __init__(self, base: str):
+        self.path = os.path.join(base, PEM_KEY_FILE)
+
+    def read_key(self) -> ec.EllipticCurvePrivateKey:
+        with open(self.path, "rb") as f:
+            return key_from_pem(f.read())
+
+    def write_key(self, key: ec.EllipticCurvePrivateKey) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(self.path, "w") as f:
+            f.write(key_to_pem(key))

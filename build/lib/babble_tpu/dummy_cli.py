@@ -1,0 +1,59 @@
+"""Dummy chat client CLI — the app side of the socket proxy split
+(reference: cmd/dummy/main.go + cmd/dummy/commands/root.go:41-66).
+
+Reads lines from stdin and submits "<name>: <line>" as transactions;
+committed blocks are printed as they arrive through the commit handler.
+
+    python -m babble_tpu.dummy_cli --name Alice \
+        --client-listen 127.0.0.1:1339 --proxy-connect 127.0.0.1:1338
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from .proxy.socket_babble import DummySocketClient
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dummy", description="Chat demo client")
+    p.add_argument("--name", default="node", help="Name to prefix messages with")
+    p.add_argument("--client-listen", default="127.0.0.1:1339",
+                   help="Listen IP:Port for this client (babble connects here)")
+    p.add_argument("--proxy-connect", default="127.0.0.1:1338",
+                   help="IP:Port of babble's proxy listener")
+    p.add_argument("--log", default="info", help="Log level")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=getattr(logging, args.log.upper(), logging.INFO))
+    logger = logging.getLogger("dummy")
+
+    client = DummySocketClient(
+        node_addr=args.proxy_connect,
+        bind_addr=args.client_listen,
+        logger=logger,
+    )
+
+    # print committed chat messages as they arrive
+    base_commit = client.state.commit_handler
+
+    def commit_and_print(block):
+        for tx in block.transactions():
+            print(f"\n[block {block.index()}] {tx.decode(errors='replace')}")
+        return base_commit(block)
+
+    client.state.commit_handler = commit_and_print  # type: ignore[method-assign]
+
+    print("Enter your text: ", end="", flush=True)
+    for line in sys.stdin:
+        text = line.strip()
+        if text:
+            client.submit_tx(f"{args.name}: {text}".encode())
+        print("Enter your text: ", end="", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

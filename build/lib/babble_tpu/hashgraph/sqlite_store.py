@@ -1,0 +1,322 @@
+"""Persistent store: InmemStore write-through + SQLite.
+
+The TPU-native equivalent of the reference's BadgerStore
+(reference: src/hashgraph/badger_store.go): every event / round / block /
+frame / root is written through to disk, reads fall back cache-then-db, and
+`db_topological_events` replays insertion order for Bootstrap
+(reference: src/hashgraph/badger_store.go:403-444).
+
+SQLite (stdlib) replaces BadgerDB; the reference's key scheme
+(`topo_%09d`, `{participant}__event_%09d`, ... reference:
+src/hashgraph/badger_store.go:121-147) becomes indexed relational tables,
+which buys us ordered replay and participant-index lookups for free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+from typing import Dict, List, Tuple
+
+from ..common import StoreErr, StoreErrType, is_store_err
+from ..peers import Peer, Peers
+from .block import Block
+from .event import Event
+from .frame import Frame
+from .inmem_store import InmemStore
+from .root import Root
+from .round_info import RoundInfo
+from .store import Store
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS events (
+    hex TEXT PRIMARY KEY,
+    topo_index INTEGER NOT NULL,
+    creator TEXT NOT NULL,
+    idx INTEGER NOT NULL,
+    data TEXT NOT NULL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS events_topo ON events(topo_index);
+CREATE UNIQUE INDEX IF NOT EXISTS events_creator_idx ON events(creator, idx);
+CREATE TABLE IF NOT EXISTS rounds (
+    idx INTEGER PRIMARY KEY,
+    data TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS blocks (
+    idx INTEGER PRIMARY KEY,
+    data TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS frames (
+    idx INTEGER PRIMARY KEY,
+    data TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS roots (
+    participant TEXT PRIMARY KEY,
+    data TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS participants (
+    pub_key_hex TEXT PRIMARY KEY,
+    net_addr TEXT NOT NULL
+);
+"""
+
+
+class SQLiteStore(Store):
+    def __init__(self, participants: Peers, cache_size: int, path: str, existing_db: bool = False):
+        self._path = path
+        self.inmem = InmemStore(participants, cache_size)
+        self._need_bootstrap = existing_db
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # access is serialized by the node's core_lock, so sharing the
+        # connection across the node's worker threads is safe
+        self.db = sqlite3.connect(path, check_same_thread=False)
+        self.db.executescript(_SCHEMA)
+
+        if existing_db:
+            # participants come from the db, roots re-read from disk
+            db_participants = self._db_participants()
+            if len(db_participants):
+                self.inmem = InmemStore(db_participants, cache_size)
+                for pk in db_participants.to_pub_key_slice():
+                    try:
+                        self.inmem.roots_by_participant[pk] = self._db_get_root(pk)
+                    except StoreErr:
+                        pass
+                self.inmem._roots_by_self_parent = None
+        else:
+            with self.db:
+                for p in participants.to_peer_slice():
+                    self.db.execute(
+                        "INSERT OR REPLACE INTO participants VALUES (?, ?)",
+                        (p.pub_key_hex, p.net_addr),
+                    )
+                for pk, root in self.inmem.roots_by_participant.items():
+                    self._db_set_root(pk, root)
+
+        self._topo_counter = self._db_max_topo() + 1
+
+    # -- factory -----------------------------------------------------------
+
+    @classmethod
+    def load_or_create(cls, participants: Peers, cache_size: int, path: str) -> "SQLiteStore":
+        if os.path.exists(path):
+            return cls(participants, cache_size, path, existing_db=True)
+        return cls(participants, cache_size, path, existing_db=False)
+
+    # -- db helpers --------------------------------------------------------
+
+    def _db_participants(self) -> Peers:
+        rows = self.db.execute("SELECT pub_key_hex, net_addr FROM participants").fetchall()
+        return Peers.from_slice([Peer(net_addr=a, pub_key_hex=pk) for pk, a in rows])
+
+    def _db_max_topo(self) -> int:
+        row = self.db.execute("SELECT MAX(topo_index) FROM events").fetchone()
+        return row[0] if row and row[0] is not None else -1
+
+    def _db_set_root(self, participant: str, root: Root) -> None:
+        self.db.execute(
+            "INSERT OR REPLACE INTO roots VALUES (?, ?)",
+            (participant, json.dumps(root.to_canonical())),
+        )
+
+    def _db_get_root(self, participant: str) -> Root:
+        row = self.db.execute(
+            "SELECT data FROM roots WHERE participant = ?", (participant,)
+        ).fetchone()
+        if row is None:
+            raise StoreErr("SQLite.Roots", StoreErrType.KEY_NOT_FOUND, participant)
+        return Root.from_canonical(json.loads(row[0]))
+
+    def db_topological_events(self) -> List[Event]:
+        """All events in insertion order, for Bootstrap replay. Consensus
+        metadata is deliberately stripped (from_json, not from_store_json):
+        the replay recomputes coordinates/rounds through the full pipeline."""
+        rows = self.db.execute(
+            "SELECT data FROM events ORDER BY topo_index"
+        ).fetchall()
+        return [Event.from_json(json.loads(r[0])) for r in rows]
+
+    # -- Store interface: write-through then read-through ------------------
+
+    def cache_size(self) -> int:
+        return self.inmem.cache_size()
+
+    def participants(self) -> Peers:
+        return self.inmem.participants()
+
+    def roots_by_self_parent(self) -> Dict[str, Root]:
+        return self.inmem.roots_by_self_parent()
+
+    def get_event(self, key: str) -> Event:
+        try:
+            return self.inmem.get_event(key)
+        except StoreErr:
+            row = self.db.execute("SELECT data FROM events WHERE hex = ?", (key,)).fetchone()
+            if row is None:
+                raise StoreErr("SQLite.Events", StoreErrType.KEY_NOT_FOUND, key)
+            return Event.from_store_json(json.loads(row[0]))
+
+    def set_event(self, event: Event) -> None:
+        with self.db:
+            row = self.db.execute(
+                "SELECT topo_index FROM events WHERE hex = ?", (event.hex(),)
+            ).fetchone()
+            peer = self.inmem.participants().by_pub_key[event.creator()]
+            last_known = self.inmem.participant_events_cache.known().get(peer.id, -1)
+            if event.index() > last_known:
+                # advances the creator's sequence: register in the
+                # participant rolling index
+                self.inmem.set_event(event)
+            else:
+                # write-back of an already-registered event (possibly
+                # LRU-evicted meanwhile): refresh the cache only —
+                # re-registering would hit a rolled participant window
+                self.inmem.event_cache.add(event.hex(), event)
+            topo = row[0] if row else self._topo_counter
+            if row is None:
+                self._topo_counter += 1
+            self.db.execute(
+                "INSERT OR REPLACE INTO events VALUES (?, ?, ?, ?, ?)",
+                (
+                    event.hex(),
+                    topo,
+                    event.creator(),
+                    event.index(),
+                    json.dumps(event.to_store_json()),
+                ),
+            )
+
+    def participant_events(self, participant: str, skip: int) -> List[str]:
+        try:
+            return self.inmem.participant_events(participant, skip)
+        except StoreErr:
+            rows = self.db.execute(
+                "SELECT hex FROM events WHERE creator = ? AND idx > ? ORDER BY idx",
+                (participant, skip),
+            ).fetchall()
+            return [r[0] for r in rows]
+
+    def participant_event(self, participant: str, index: int) -> str:
+        try:
+            return self.inmem.participant_event(participant, index)
+        except StoreErr:
+            row = self.db.execute(
+                "SELECT hex FROM events WHERE creator = ? AND idx = ?",
+                (participant, index),
+            ).fetchone()
+            if row is None:
+                raise StoreErr("SQLite.Events", StoreErrType.KEY_NOT_FOUND, str(index))
+            return row[0]
+
+    def last_event_from(self, participant: str) -> Tuple[str, bool]:
+        return self.inmem.last_event_from(participant)
+
+    def last_consensus_event_from(self, participant: str) -> Tuple[str, bool]:
+        return self.inmem.last_consensus_event_from(participant)
+
+    def known_events(self) -> Dict[int, int]:
+        return self.inmem.known_events()
+
+    def consensus_events(self) -> List[str]:
+        return self.inmem.consensus_events()
+
+    def consensus_events_count(self) -> int:
+        return self.inmem.consensus_events_count()
+
+    def add_consensus_event(self, event: Event) -> None:
+        self.inmem.add_consensus_event(event)
+
+    def seed_last_consensus_event(self, participant: str, event_hex: str) -> None:
+        self.inmem.seed_last_consensus_event(participant, event_hex)
+
+    def get_round(self, r: int) -> RoundInfo:
+        try:
+            return self.inmem.get_round(r)
+        except StoreErr:
+            row = self.db.execute("SELECT data FROM rounds WHERE idx = ?", (r,)).fetchone()
+            if row is None:
+                raise StoreErr("SQLite.Rounds", StoreErrType.KEY_NOT_FOUND, str(r))
+            return RoundInfo.from_json(json.loads(row[0]))
+
+    def set_round(self, r: int, round_info: RoundInfo) -> None:
+        self.inmem.set_round(r, round_info)
+        with self.db:
+            self.db.execute(
+                "INSERT OR REPLACE INTO rounds VALUES (?, ?)",
+                (r, json.dumps(round_info.to_json())),
+            )
+
+    def last_round(self) -> int:
+        return self.inmem.last_round()
+
+    def round_witnesses(self, r: int) -> List[str]:
+        try:
+            return self.get_round(r).witnesses()
+        except StoreErr:
+            return []
+
+    def round_events(self, r: int) -> int:
+        try:
+            return len(self.get_round(r).events)
+        except StoreErr:
+            return 0
+
+    def get_root(self, participant: str) -> Root:
+        try:
+            return self.inmem.get_root(participant)
+        except StoreErr:
+            return self._db_get_root(participant)
+
+    def get_block(self, index: int) -> Block:
+        try:
+            return self.inmem.get_block(index)
+        except StoreErr:
+            row = self.db.execute("SELECT data FROM blocks WHERE idx = ?", (index,)).fetchone()
+            if row is None:
+                raise StoreErr("SQLite.Blocks", StoreErrType.KEY_NOT_FOUND, str(index))
+            return Block.from_json(json.loads(row[0]))
+
+    def set_block(self, block: Block) -> None:
+        self.inmem.set_block(block)
+        with self.db:
+            self.db.execute(
+                "INSERT OR REPLACE INTO blocks VALUES (?, ?)",
+                (block.index(), json.dumps(block.to_json())),
+            )
+
+    def last_block_index(self) -> int:
+        return self.inmem.last_block_index()
+
+    def get_frame(self, index: int) -> Frame:
+        try:
+            return self.inmem.get_frame(index)
+        except StoreErr:
+            row = self.db.execute("SELECT data FROM frames WHERE idx = ?", (index,)).fetchone()
+            if row is None:
+                raise StoreErr("SQLite.Frames", StoreErrType.KEY_NOT_FOUND, str(index))
+            return Frame.from_json(json.loads(row[0]))
+
+    def set_frame(self, frame: Frame) -> None:
+        self.inmem.set_frame(frame)
+        with self.db:
+            self.db.execute(
+                "INSERT OR REPLACE INTO frames VALUES (?, ?)",
+                (frame.round, json.dumps(frame.to_json())),
+            )
+
+    def reset(self, roots: Dict[str, Root]) -> None:
+        self.inmem.reset(roots)
+        with self.db:
+            for pk, root in roots.items():
+                self._db_set_root(pk, root)
+
+    def close(self) -> None:
+        self.db.close()
+
+    def need_bootstrap(self) -> bool:
+        return self._need_bootstrap
+
+    def store_path(self) -> str:
+        return self._path

@@ -1,0 +1,27 @@
+from .commands import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from .transport import RPC, RPCResponse, Transport, TransportError
+from .inmem_transport import InmemTransport, new_inmem_addr
+from .tcp_transport import TCPTransport
+
+__all__ = [
+    "SyncRequest",
+    "SyncResponse",
+    "EagerSyncRequest",
+    "EagerSyncResponse",
+    "FastForwardRequest",
+    "FastForwardResponse",
+    "RPC",
+    "RPCResponse",
+    "Transport",
+    "TransportError",
+    "InmemTransport",
+    "new_inmem_addr",
+    "TCPTransport",
+]

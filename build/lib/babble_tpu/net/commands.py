@@ -1,0 +1,126 @@
+"""Gossip RPC message types (reference: src/net/commands.go:5-40).
+
+`known` maps participant ID -> last known event index, the compressed
+"what I have" summary that drives EventDiff on the responder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..hashgraph import Block, Frame, Section, WireEvent
+
+
+@dataclass
+class SyncRequest:
+    from_id: int
+    known: Dict[int, int]
+
+    def to_json(self) -> dict:
+        return {"FromID": self.from_id, "Known": {str(k): v for k, v in self.known.items()}}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SyncRequest":
+        return cls(
+            from_id=d["FromID"],
+            known={int(k): v for k, v in d.get("Known", {}).items()},
+        )
+
+
+@dataclass
+class SyncResponse:
+    from_id: int
+    sync_limit: bool = False
+    events: List[WireEvent] = field(default_factory=list)
+    known: Dict[int, int] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "FromID": self.from_id,
+            "SyncLimit": self.sync_limit,
+            "Events": [e.to_json() for e in self.events],
+            "Known": {str(k): v for k, v in self.known.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SyncResponse":
+        return cls(
+            from_id=d["FromID"],
+            sync_limit=d.get("SyncLimit", False),
+            events=[WireEvent.from_json(e) for e in d.get("Events", [])],
+            known={int(k): v for k, v in d.get("Known", {}).items()},
+        )
+
+
+@dataclass
+class EagerSyncRequest:
+    from_id: int
+    events: List[WireEvent] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"FromID": self.from_id, "Events": [e.to_json() for e in self.events]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "EagerSyncRequest":
+        return cls(
+            from_id=d["FromID"],
+            events=[WireEvent.from_json(e) for e in d.get("Events", [])],
+        )
+
+
+@dataclass
+class EagerSyncResponse:
+    from_id: int
+    success: bool = False
+
+    def to_json(self) -> dict:
+        return {"FromID": self.from_id, "Success": self.success}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "EagerSyncResponse":
+        return cls(from_id=d["FromID"], success=d.get("Success", False))
+
+
+@dataclass
+class FastForwardRequest:
+    from_id: int
+
+    def to_json(self) -> dict:
+        return {"FromID": self.from_id}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FastForwardRequest":
+        return cls(from_id=d["FromID"])
+
+
+@dataclass
+class FastForwardResponse:
+    from_id: int
+    block: Optional[Block] = None
+    frame: Optional[Frame] = None
+    snapshot: bytes = b""
+    section: Optional[Section] = None
+
+    def to_json(self) -> dict:
+        from ..utils.codec import b64e
+
+        return {
+            "FromID": self.from_id,
+            "Block": self.block.to_json() if self.block is not None else None,
+            "Frame": self.frame.to_json() if self.frame is not None else None,
+            "Snapshot": b64e(self.snapshot),
+            "Section": self.section.to_json() if self.section is not None else None,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FastForwardResponse":
+        from ..utils.codec import b64d
+
+        return cls(
+            from_id=d["FromID"],
+            block=Block.from_json(d["Block"]) if d.get("Block") else None,
+            frame=Frame.from_json(d["Frame"]) if d.get("Frame") else None,
+            snapshot=b64d(d.get("Snapshot", "")),
+            section=Section.from_json(d["Section"]) if d.get("Section") else None,
+        )

@@ -1,0 +1,84 @@
+"""In-process loopback transport — the fake network for multi-node tests
+(reference: src/net/inmem_transport.go).
+
+Each transport owns a consumer queue; `connect` wires a peer address to
+another InmemTransport so `make_rpc` can deliver an RPC straight onto the
+remote consumer queue and block on the per-RPC response queue.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Dict
+
+from .commands import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from .transport import RPC, Transport, TransportError
+
+_addr_counter = itertools.count()
+
+
+def new_inmem_addr() -> str:
+    return f"inmem-{next(_addr_counter)}"
+
+
+class InmemTransport(Transport):
+    def __init__(self, addr: str = "", timeout: float = 2.0):
+        self._consumer: "queue.Queue[RPC]" = queue.Queue()
+        self._addr = addr or new_inmem_addr()
+        self.timeout = timeout
+        self._peers: Dict[str, "InmemTransport"] = {}
+        self._lock = threading.RLock()
+
+    def consumer(self) -> "queue.Queue[RPC]":
+        return self._consumer
+
+    def local_addr(self) -> str:
+        return self._addr
+
+    def _make_rpc(self, target: str, command) -> object:
+        with self._lock:
+            peer = self._peers.get(target)
+        if peer is None:
+            raise TransportError(f"failed to connect to peer: {target}")
+        rpc = RPC(command=command)
+        peer._consumer.put(rpc)
+        try:
+            resp = rpc.resp_queue.get(timeout=self.timeout)
+        except queue.Empty:
+            raise TransportError("command timed out") from None
+        if resp.error:
+            raise TransportError(resp.error)
+        return resp.response
+
+    def sync(self, target: str, req: SyncRequest) -> SyncResponse:
+        return self._make_rpc(target, req)
+
+    def eager_sync(self, target: str, req: EagerSyncRequest) -> EagerSyncResponse:
+        return self._make_rpc(target, req)
+
+    def fast_forward(self, target: str, req: FastForwardRequest) -> FastForwardResponse:
+        return self._make_rpc(target, req)
+
+    def connect(self, peer_addr: str, transport: "InmemTransport") -> None:
+        with self._lock:
+            self._peers[peer_addr] = transport
+
+    def disconnect(self, peer_addr: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_addr, None)
+
+    def disconnect_all(self) -> None:
+        with self._lock:
+            self._peers.clear()
+
+    def close(self) -> None:
+        self.disconnect_all()

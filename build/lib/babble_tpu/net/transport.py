@@ -1,0 +1,77 @@
+"""Transport abstraction for inter-validator gossip RPC.
+
+Mirrors the three-verb contract of the reference transport layer
+(reference: src/net/transport.go:12-60): a transport can issue Sync,
+EagerSync and FastForward requests to a peer, and exposes a consumer
+queue on which inbound RPCs arrive for the node's background dispatcher.
+Responses travel back on a per-RPC response queue.
+"""
+
+from __future__ import annotations
+
+import queue
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .commands import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+)
+
+
+@dataclass
+class RPCResponse:
+    response: Any = None
+    error: Optional[str] = None
+
+
+@dataclass
+class RPC:
+    """An inbound request paired with the queue its answer goes back on
+    (reference: src/net/transport.go:12-21)."""
+
+    command: Any
+    resp_queue: "queue.Queue[RPCResponse]" = field(
+        default_factory=lambda: queue.Queue(maxsize=1)
+    )
+
+    def respond(self, response: Any, error: Optional[str] = None) -> None:
+        self.resp_queue.put(RPCResponse(response=response, error=error))
+
+
+class Transport(ABC):
+    """The gossip communication backend (reference: src/net/transport.go:25-44)."""
+
+    @abstractmethod
+    def consumer(self) -> "queue.Queue[RPC]":
+        """Queue on which inbound RPCs are delivered."""
+
+    @abstractmethod
+    def local_addr(self) -> str: ...
+
+    @abstractmethod
+    def sync(self, target: str, req: SyncRequest) -> SyncResponse: ...
+
+    @abstractmethod
+    def eager_sync(self, target: str, req: EagerSyncRequest) -> EagerSyncResponse: ...
+
+    @abstractmethod
+    def fast_forward(
+        self, target: str, req: FastForwardRequest
+    ) -> FastForwardResponse: ...
+
+    @abstractmethod
+    def close(self) -> None: ...
+
+
+class TransportError(Exception):
+    pass
+
+
+class TimeoutError_(TransportError):
+    pass

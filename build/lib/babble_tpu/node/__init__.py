@@ -1,0 +1,20 @@
+from .config import Config, default_config, test_config
+from .control_timer import ControlTimer, new_random_control_timer
+from .core import Core
+from .node import Node
+from .peer_selector import PeerSelector, RandomPeerSelector
+from .state import NodeState, NodeStateMachine
+
+__all__ = [
+    "Config",
+    "default_config",
+    "test_config",
+    "ControlTimer",
+    "new_random_control_timer",
+    "Core",
+    "Node",
+    "PeerSelector",
+    "RandomPeerSelector",
+    "NodeState",
+    "NodeStateMachine",
+]

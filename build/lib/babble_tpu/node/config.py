@@ -1,0 +1,44 @@
+"""Node runtime configuration (reference: src/node/config.go).
+
+Durations are seconds (floats), not Go time.Durations.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+
+def _default_logger() -> logging.Logger:
+    return logging.getLogger("babble.node")
+
+
+@dataclass
+class Config:
+    heartbeat_timeout: float = 1.0
+    tcp_timeout: float = 1.0
+    cache_size: int = 500
+    sync_limit: int = 100
+    # consensus backend: "cpu" runs the scalar five-pass pipeline on host;
+    # "tpu" dispatches DivideRounds/DecideFame/DecideRoundReceived to the
+    # device kernels (babble_tpu/tpu/), falling back to the CPU path on any
+    # state the dense grid cannot express (SURVEY §7 swappable-backend plan;
+    # reference boundary: src/node/core.go:335-377)
+    consensus_backend: str = "cpu"
+    # with consensus_backend="tpu": shard the device passes over this many
+    # chips as a jax.sharding.Mesh (0/1 = single device). The mesh path
+    # routes through babble_tpu/tpu/sharded.py (rounds-sharded fame with
+    # ppermute ring shifts, events/chains-sharded tables); any state it
+    # cannot express falls down the same ladder as the single-device path
+    mesh_devices: int = 0
+    logger: logging.Logger = field(default_factory=_default_logger)
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast heartbeat for in-process integration tests
+    (reference: src/node/config.go:48-53 + test usage)."""
+    return Config(heartbeat_timeout=0.005, tcp_timeout=1.0, cache_size=1000, sync_limit=300)

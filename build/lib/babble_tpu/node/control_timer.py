@@ -1,0 +1,100 @@
+"""Randomized gossip heartbeat timer (reference: src/node/control_timer.go).
+
+Fires on a base + rand(base) schedule onto `tick_ch`; the node resets it
+whenever there is something to gossip about and stops it when idle.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from typing import Callable, Optional
+
+
+class ControlTimer:
+    def __init__(self, timer_factory: Callable[[], Optional[float]]):
+        self.timer_factory = timer_factory
+        self.tick_ch: "queue.Queue[None]" = queue.Queue(maxsize=1)
+        self.set = False
+        self._cv = threading.Condition()
+        self._deadline: Optional[float] = None
+        self._reset = False
+        self._stop = False
+        self._shutdown = False
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        thread = threading.Thread(target=self._loop, name="control-timer", daemon=True)
+        thread.start()
+        self._thread = thread
+
+    def _arm(self) -> Optional[float]:
+        self.set = True
+        import time
+
+        interval = self.timer_factory()
+        return None if interval is None else time.monotonic() + interval
+
+    def _loop(self) -> None:
+        import time
+
+        deadline = self._arm()
+        while True:
+            with self._cv:
+                wait = None
+                if deadline is not None:
+                    wait = max(0.0, deadline - time.monotonic())
+                self._cv.wait(timeout=min(wait, 0.05) if wait is not None else 0.05)
+                if self._shutdown:
+                    self.set = False
+                    return
+                if self._reset:
+                    self._reset = False
+                    deadline = self._arm()
+                    continue
+                if self._stop:
+                    self._stop = False
+                    deadline = None
+                    self.set = False
+                    continue
+            if deadline is not None and time.monotonic() >= deadline:
+                # blocking hand-off like Go's unbuffered channel send, but
+                # interruptible by shutdown
+                while True:
+                    try:
+                        self.tick_ch.put(None, timeout=0.1)
+                        break
+                    except queue.Full:
+                        with self._cv:
+                            if self._shutdown or self._reset or self._stop:
+                                break
+                self.set = False
+                deadline = None
+
+    def reset(self) -> None:
+        with self._cv:
+            self._reset = True
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+
+
+def new_random_control_timer(base: float) -> ControlTimer:
+    def random_timeout() -> Optional[float]:
+        if base <= 0:
+            return None
+        return base + random.uniform(0, base)
+
+    return ControlTimer(random_timeout)

@@ -51,15 +51,6 @@ def _is_benign_race(e: Exception) -> bool:
     return "Self-parent not last known event by creator" in str(e)
 
 
-def _is_missing_parent(e: Exception) -> bool:
-    """A sync failed because an event body this store is SUPPOSED to have
-    (per its own known-events high-water mark) is gone — the signature of
-    the LRU-eviction livelock (see _gossip)."""
-    from ..common import StoreErrType, is_store_err
-
-    return is_store_err(e, StoreErrType.KEY_NOT_FOUND)
-
-
 class Node(NodeStateMachine):
     def __init__(
         self,
@@ -78,16 +69,7 @@ class Node(NodeStateMachine):
         self.local_addr = trans.local_addr()
 
         pmap = store.participants()
-        # UNBOUNDED by design (code review r5): process_decided_rounds puts
-        # here while holding core_lock, and the commit worker needs
-        # core_lock to sign — a bounded channel deadlocks the node the
-        # moment the app-commit backlog hits the bound (putter waits for a
-        # slot, consumer waits for the lock). The reference's buffered-400
-        # channel has the same latent deadlock (node.go:144-174 commits
-        # inline under coreLock); consensus outrunning a slow app is
-        # handled instead by capping served anchors at the app's committed
-        # height (_app_committed_index).
-        self.commit_ch: "queue.Queue[Block]" = queue.Queue()
+        self.commit_ch: "queue.Queue[Block]" = queue.Queue(maxsize=400)
         self.core = Core(
             id_, key, pmap, store, self.commit_ch, conf.logger,
             consensus_backend=conf.consensus_backend,
@@ -112,7 +94,6 @@ class Node(NodeStateMachine):
         # behind) must be operationally visible (ADVICE r3)
         self.fast_forward_bounces = 0
         self._consecutive_bounces = 0
-        self._missing_parent_syncs = 0
         # highest block index the APP has committed (proxy.commit_block
         # returned). The hashgraph's anchor can run a full commit channel
         # ahead of this; fast-forward serving must never anchor past it or
@@ -370,30 +351,8 @@ class Node(NodeStateMachine):
                 self.logger.debug if _is_benign_race(e) else self.logger.error
             )
             level("gossip(%s): %s", peer_addr, e)
-            # EVICTION LIVELOCK ESCAPE (round 5): a node whose undetermined
-            # backlog outgrew the store's LRU has evicted event BODIES its
-            # peers' diffs still reference as parents — but known_events()
-            # (the rolling high-water mark) still claims those events, so
-            # peers never resend them and over_sync_limit never trips.
-            # Every sync then fails with the same KEY_NOT_FOUND forever
-            # (observed: a survivor wedged at block 274 while peers ran to
-            # 570). A store that can no longer support incremental sync
-            # has exactly one recovery: fast-forward, which rebuilds it
-            # compactly from an anchor. Three consecutive missing-parent
-            # failures distinguish the livelock from a transient race.
-            if _is_missing_parent(e):
-                self._missing_parent_syncs += 1
-                if self._missing_parent_syncs >= 3:
-                    self.logger.warning(
-                        "sync livelocked on evicted events (%s); "
-                        "flipping to CatchingUp to rebuild the store", e,
-                    )
-                    self._missing_parent_syncs = 0
-                    self.set_state(NodeState.CATCHING_UP)
-                    return_event.set()
             return
 
-        self._missing_parent_syncs = 0
         with self.selector_lock:
             self.peer_selector.update_last(peer_addr)
         self.log_stats()
@@ -640,22 +599,6 @@ class Node(NodeStateMachine):
             # catch-up ping-pong shows up here instead of hiding at debug
             "fast_forward_bounces": str(self.fast_forward_bounces),
             **self._live_engine_stats(),
-            **self._mesh_stats(),
-        }
-
-    def _mesh_stats(self):
-        """Mesh product path (--mesh-devices): per-call staging vs device
-        wall time and the staged-event count — the one-shot restage cost
-        the config #5 scaling model is built on (VERDICT r4 #8)."""
-        hg = self.core.hg
-        calls = getattr(hg, "_mesh_calls", 0)
-        if not calls:
-            return {}
-        return {
-            "mesh_calls": str(calls),
-            "mesh_stage_ms_avg": f"{getattr(hg, '_mesh_stage_seconds', 0.0) / calls * 1e3:.2f}",
-            "mesh_device_ms_avg": f"{getattr(hg, '_mesh_device_seconds', 0.0) / calls * 1e3:.2f}",
-            "mesh_staged_events": str(getattr(hg, "_mesh_staged_events", 0)),
         }
 
     def _live_engine_stats(self):

@@ -1,0 +1,42 @@
+"""Gossip partner selection (reference: src/node/peer_selector.go)."""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+
+from ..peers import Peer, Peers, exclude_peer
+
+
+class PeerSelector(ABC):
+    @abstractmethod
+    def peers(self) -> Peers: ...
+
+    @abstractmethod
+    def update_last(self, peer: str) -> None: ...
+
+    @abstractmethod
+    def next(self) -> Peer: ...
+
+
+class RandomPeerSelector(PeerSelector):
+    """Uniform random choice excluding self and the last-contacted peer."""
+
+    def __init__(self, participants: Peers, local_addr: str):
+        self._peers = participants
+        self.local_addr = local_addr
+        self.last = ""
+
+    def peers(self) -> Peers:
+        return self._peers
+
+    def update_last(self, peer: str) -> None:
+        self.last = peer
+
+    def next(self) -> Peer:
+        selectable = self._peers.to_peer_slice()
+        if len(selectable) > 1:
+            _, selectable = exclude_peer(selectable, self.local_addr)
+            if len(selectable) > 1:
+                _, selectable = exclude_peer(selectable, self.last)
+        return random.choice(selectable)

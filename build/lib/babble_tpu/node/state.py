@@ -1,0 +1,64 @@
+"""Node state machine primitives (reference: src/node/state.go).
+
+Babbling / CatchingUp / Shutdown tri-state plus a WaitGroup-style tracker
+for background worker threads.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable
+
+
+class NodeState(enum.Enum):
+    BABBLING = "Babbling"
+    CATCHING_UP = "CatchingUp"
+    SHUTDOWN = "Shutdown"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class NodeStateMachine:
+    def __init__(self):
+        self._state = NodeState.BABBLING
+        self._starting = False
+        self._lock = threading.Lock()
+        self._routines = 0
+        self._cv = threading.Condition()
+
+    def get_state(self) -> NodeState:
+        with self._lock:
+            return self._state
+
+    def set_state(self, s: NodeState) -> None:
+        with self._lock:
+            self._state = s
+
+    def set_starting(self, starting: bool) -> None:
+        with self._lock:
+            self._starting = starting
+
+    def is_starting(self) -> bool:
+        with self._lock:
+            return self._starting
+
+    def go_func(self, f: Callable[[], None], name: str = "worker") -> None:
+        """Run f on a tracked daemon thread (reference: src/node/state.go:62-68)."""
+        with self._cv:
+            self._routines += 1
+
+        def _run():
+            try:
+                f()
+            finally:
+                with self._cv:
+                    self._routines -= 1
+                    self._cv.notify_all()
+
+        threading.Thread(target=_run, name=name, daemon=True).start()
+
+    def wait_routines(self, timeout: float = 30.0) -> None:
+        with self._cv:
+            self._cv.wait_for(lambda: self._routines == 0, timeout=timeout)

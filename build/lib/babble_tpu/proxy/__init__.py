@@ -1,0 +1,20 @@
+from .proxy import AppProxy, ProxyHandler
+from .inmem_proxy import InmemAppProxy
+from .dummy import InmemDummyClient, State
+from .jsonrpc import JSONRPCClient, JSONRPCError, JSONRPCServer
+from .socket_app import SocketAppProxy
+from .socket_babble import DummySocketClient, SocketBabbleProxy
+
+__all__ = [
+    "AppProxy",
+    "ProxyHandler",
+    "InmemAppProxy",
+    "InmemDummyClient",
+    "State",
+    "JSONRPCClient",
+    "JSONRPCError",
+    "JSONRPCServer",
+    "SocketAppProxy",
+    "SocketBabbleProxy",
+    "DummySocketClient",
+]

@@ -1,0 +1,60 @@
+"""Reference dummy application: a cumulative-hash state machine
+(reference: src/proxy/dummy/state.go:27-99).
+
+State hash chains over committed transactions via the two-hash Merkle fold;
+snapshots are keyed by block index. This is the app used by integration
+tests and the `--standalone` CLI mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List
+
+from ..crypto import simple_hash_from_two_hashes
+from ..hashgraph import Block
+from .inmem_proxy import InmemAppProxy
+from .proxy import ProxyHandler
+
+
+class State(ProxyHandler):
+    def __init__(self, logger: logging.Logger = None):
+        self.logger = logger or logging.getLogger("dummy")
+        self.committed_txs: List[bytes] = []
+        self.state_hash: bytes = b""
+        self.snapshots: Dict[int, bytes] = {}
+        self._lock = threading.Lock()
+
+    def commit_handler(self, block: Block) -> bytes:
+        with self._lock:
+            self.committed_txs.extend(block.transactions())
+            for tx in block.transactions():
+                self.state_hash = simple_hash_from_two_hashes(self.state_hash, tx)
+            self.snapshots[block.index()] = self.state_hash
+            return self.state_hash
+
+    def snapshot_handler(self, block_index: int) -> bytes:
+        with self._lock:
+            snap = self.snapshots.get(block_index)
+            if snap is None:
+                raise ValueError(f"snapshot {block_index} not found")
+            return snap
+
+    def restore_handler(self, snapshot: bytes) -> bytes:
+        with self._lock:
+            self.state_hash = snapshot
+            return self.state_hash
+
+    def get_committed_transactions(self) -> List[bytes]:
+        with self._lock:
+            return list(self.committed_txs)
+
+
+class InmemDummyClient(InmemAppProxy):
+    """A dummy app wired straight into an in-process proxy
+    (reference: src/proxy/dummy/inmem_dummy.go)."""
+
+    def __init__(self, logger: logging.Logger = None):
+        self.state = State(logger)
+        super().__init__(self.state)

@@ -1,0 +1,42 @@
+"""In-process AppProxy backed by a ProxyHandler
+(reference: src/proxy/inmem/inmem_proxy.go)."""
+
+from __future__ import annotations
+
+import queue
+from typing import Callable, Optional
+
+from ..hashgraph import Block
+from .proxy import AppProxy, ProxyHandler
+
+
+class InmemAppProxy(AppProxy):
+    def __init__(self, handler: ProxyHandler):
+        self.handler = handler
+        self._submit: "queue.Queue[bytes]" = queue.Queue()
+        self._commit_handler: Optional[Callable[[Block], bytes]] = None
+
+    def submit_tx(self, tx: bytes) -> None:
+        # defensive copy: the caller may mutate its buffer after submit
+        self._submit.put(bytes(tx))
+
+    def submit_ch(self) -> "queue.Queue[bytes]":
+        return self._submit
+
+    def set_commit_handler(self, handler: Callable[[Block], bytes]) -> None:
+        """Override the commit path with an embedding-style callback
+        (the mobile CommitHandler contract, reference:
+        src/mobile/handlers.go:11-17). The callback returns the new app
+        state hash, exactly like ProxyHandler.commit_handler."""
+        self._commit_handler = handler
+
+    def commit_block(self, block: Block) -> bytes:
+        if self._commit_handler is not None:
+            return self._commit_handler(block)
+        return self.handler.commit_handler(block)
+
+    def get_snapshot(self, block_index: int) -> bytes:
+        return self.handler.snapshot_handler(block_index)
+
+    def restore(self, snapshot: bytes) -> bytes:
+        return self.handler.restore_handler(snapshot)

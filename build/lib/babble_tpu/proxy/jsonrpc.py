@@ -1,0 +1,288 @@
+"""Minimal JSON-RPC 1.0 over TCP, newline-delimited — the framing used by
+the reference's socket proxies (Go net/rpc/jsonrpc; reference:
+src/proxy/socket/app/socket_app_proxy_client.go:42-99,
+src/proxy/socket/babble/socket_babble_proxy_server.go:71-117).
+
+Request:  {"method": "Service.Method", "params": [arg], "id": n}
+Response: {"id": n, "result": ..., "error": null | "msg"}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.netaddr import split_hostport
+
+
+class JSONRPCError(Exception):
+    pass
+
+
+# one request/response line: block commits and app snapshots ride these,
+# so generous — but bounded, like the gossip transport's frame cap
+# (net/tcp_transport.py DEFAULT_MAX_FRAME)
+DEFAULT_MAX_LINE = 64 << 20
+
+# server-side idle connection recycling age
+DEFAULT_IDLE_TIMEOUT = 600.0
+
+# client-side proactive reconnect age: DERIVED from the server timeout
+# (90%) so the two ends cannot drift apart — a recycled-by-the-server
+# connection is replaced BEFORE a request is sent on it, never by
+# resending after a failure, which could double-execute a non-idempotent
+# call (State.CommitBlock applied twice silently diverges the app state:
+# "hung up without replying" does not guarantee "not executed").
+# Anyone constructing a JSONRPCServer with a custom idle_timeout must give
+# its clients an idle_reconnect strictly below it for the same reason.
+DEFAULT_IDLE_RECONNECT = 0.9 * DEFAULT_IDLE_TIMEOUT
+
+
+def _read_bounded_line(rfile, max_line: int):
+    """(line, oversized): one newline-terminated line of payload
+    <= max_line bytes. line is None when the stream closed or the line is
+    over the limit (the caller hangs up — never buffer an unbounded
+    line); oversized distinguishes the limit case so the server can send
+    an error reply before closing. The single home of the boundary
+    arithmetic for both the client and the server."""
+    line = rfile.readline(max_line + 2)
+    if not line:
+        return None, False
+    if not line.endswith(b"\n"):
+        # either the limit truncated the read (oversized) or the stream
+        # ended mid-line (EOF — not the peer's size problem)
+        return None, len(line) > max_line
+    if len(line) > max_line + 1:
+        return None, True
+    return line, False
+
+
+class JSONRPCClient:
+    """One persistent connection, serialized calls.
+
+    No post-send retries: a request that failed mid-call may still have
+    executed server-side, so resending could double-apply it. The only
+    failure mode retries were for — the server recycling an idle
+    connection — is prevented up front by reconnecting when the
+    connection's age since last use exceeds ``idle_reconnect``.
+    """
+
+    def __init__(self, addr: str, timeout: float = 5.0,
+                 max_line: Optional[int] = None,
+                 idle_reconnect: float = DEFAULT_IDLE_RECONNECT):
+        self.addr = addr
+        self.timeout = timeout
+        self.max_line = DEFAULT_MAX_LINE if max_line is None else max_line
+        self.idle_reconnect = idle_reconnect
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._next_id = 0
+        self._last_used = 0.0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        host, port = split_hostport(self.addr)
+        self._sock = socket.create_connection((host, port), timeout=self.timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self._sock.makefile("rb")
+
+    def call(self, method: str, param: Any) -> Any:
+        with self._lock:
+            # proactive recycle of idle connections (see class docstring)
+            if (
+                self._sock is not None
+                and time.monotonic() - self._last_used >= self.idle_reconnect
+            ):
+                self.close_locked()
+            if self._sock is None:
+                try:
+                    self._connect()
+                except OSError as exc:
+                    self.close_locked()
+                    raise JSONRPCError(
+                        f"connect to {self.addr}: {exc}"
+                    ) from exc
+            self._next_id += 1
+            msg = json.dumps(
+                {"method": method, "params": [param], "id": self._next_id}
+            ).encode() + b"\n"
+            if len(msg) > self.max_line + 1:
+                # the server would refuse this line; failing here avoids
+                # shipping tens of MB just to be hung up on
+                raise JSONRPCError(
+                    f"rpc {method}: request line too large "
+                    f"({len(msg)} > {self.max_line})"
+                )
+            try:
+                self._sock.sendall(msg)
+                self._last_used = time.monotonic()
+                line = self._rfile.readline(self.max_line + 2)
+                if not line:
+                    raise ConnectionError("connection closed")
+            except (OSError, AttributeError) as exc:
+                self.close_locked()
+                raise JSONRPCError(
+                    f"rpc {method} to {self.addr}: {exc}"
+                ) from exc
+            self._last_used = time.monotonic()
+            if not line.endswith(b"\n") or len(line) > self.max_line + 1:
+                # bounded read: a server streaming an endless response
+                # line must not grow our memory without limit
+                self.close_locked()
+                raise JSONRPCError(
+                    f"rpc {method}: response line too large"
+                )
+            resp = json.loads(line)
+            if resp.get("error"):
+                raise JSONRPCError(str(resp["error"]))
+            return resp.get("result")
+
+    def close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_locked()
+
+
+class JSONRPCServer:
+    """Accept loop dispatching "Service.Method" to registered handlers.
+
+    Handlers take the single decoded param and return a JSON-encodable
+    result; exceptions become the response's error string.
+    """
+
+    def __init__(self, bind_addr: str, max_line: int = DEFAULT_MAX_LINE,
+                 max_inbound: int = 64,
+                 idle_timeout: float = DEFAULT_IDLE_TIMEOUT):
+        host, port = split_hostport(bind_addr)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        lhost, lport = self._listener.getsockname()
+        self.addr = f"{lhost}:{lport}"
+        self.max_line = max_line
+        # accepted sockets get a read timeout so idle (or deliberately
+        # silent) connections release their semaphore slot instead of
+        # pinning it forever; a legitimate long-idle app client simply
+        # reconnects on its next call
+        self.idle_timeout = idle_timeout
+        self._conn_slots = threading.BoundedSemaphore(max_inbound)
+        self._handlers: Dict[str, Callable[[Any], Any]] = {}
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"jsonrpc-{self.addr}", daemon=True
+        )
+
+    def register(self, method: str, handler: Callable[[Any], Any]) -> None:
+        self._handlers[method] = handler
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            if not self._conn_slots.acquire(blocking=False):
+                # inbound cap: refuse rather than grow a thread per dial
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(
+                target=self._serve_conn, args=(sock,), daemon=True
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(self.idle_timeout)
+            rfile = sock.makefile("rb")
+            while not self._shutdown.is_set():
+                line, oversized = _read_bounded_line(rfile, self.max_line)
+                if line is None:
+                    if oversized:
+                        # tell the peer WHY before hanging up (no id was
+                        # parseable — the line was never buffered); the
+                        # client surfaces this instead of a bare
+                        # connection reset it cannot distinguish from a
+                        # recycled connection
+                        self._reply_error(
+                            sock, None,
+                            f"request line exceeds {self.max_line} bytes",
+                        )
+                    return
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    self._reply_error(sock, None, "malformed JSON request")
+                    return
+                if not isinstance(req, dict) or not isinstance(
+                    req.get("method", ""), str
+                ):
+                    # malformed-but-valid JSON: error out, don't guess
+                    self._reply_error(
+                        sock,
+                        req.get("id") if isinstance(req, dict) else None,
+                        "malformed request object",
+                    )
+                    return
+                rid = req.get("id")
+                handler = self._handlers.get(req.get("method", ""))
+                if handler is None:
+                    out = {
+                        "id": rid,
+                        "result": None,
+                        "error": f"unknown method {req.get('method')}",
+                    }
+                else:
+                    params = req.get("params") or [None]
+                    try:
+                        out = {
+                            "id": rid,
+                            "result": handler(params[0]),
+                            "error": None,
+                        }
+                    except Exception as exc:  # noqa: BLE001
+                        out = {"id": rid, "result": None, "error": str(exc)}
+                sock.sendall(json.dumps(out).encode() + b"\n")
+        except (OSError, json.JSONDecodeError):
+            pass
+        finally:
+            self._conn_slots.release()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply_error(sock: socket.socket, rid, msg: str) -> None:
+        """Best-effort error response before a hang-up (the connection is
+        unusable either way; the reply just makes the cause visible)."""
+        try:
+            sock.sendall(
+                json.dumps({"id": rid, "result": None, "error": msg}).encode()
+                + b"\n"
+            )
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

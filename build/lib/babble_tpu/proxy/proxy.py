@@ -1,0 +1,42 @@
+"""Application interface contracts (reference: src/proxy/proxy.go:7-12,
+src/proxy/handlers.go:10-24).
+
+AppProxy is the engine-side view of the application: a queue of submitted
+transactions in, committed blocks (and snapshot/restore calls) out.
+ProxyHandler is the application-side contract.
+"""
+
+from __future__ import annotations
+
+import queue
+from abc import ABC, abstractmethod
+
+from ..hashgraph import Block
+
+
+class AppProxy(ABC):
+    @abstractmethod
+    def submit_ch(self) -> "queue.Queue[bytes]":
+        """Queue of raw transactions submitted by the app."""
+
+    @abstractmethod
+    def commit_block(self, block: Block) -> bytes:
+        """Deliver a committed block to the app; returns the app state hash."""
+
+    @abstractmethod
+    def get_snapshot(self, block_index: int) -> bytes: ...
+
+    @abstractmethod
+    def restore(self, snapshot: bytes) -> bytes:
+        """Restore app state from a snapshot; returns the resulting state hash."""
+
+
+class ProxyHandler(ABC):
+    @abstractmethod
+    def commit_handler(self, block: Block) -> bytes: ...
+
+    @abstractmethod
+    def snapshot_handler(self, block_index: int) -> bytes: ...
+
+    @abstractmethod
+    def restore_handler(self, snapshot: bytes) -> bytes: ...

@@ -1,0 +1,66 @@
+"""Babble-side socket proxy (reference: src/proxy/socket/app/ —
+socket_app_proxy.go, socket_app_proxy_client.go:42-99,
+socket_app_proxy_server.go:63-71).
+
+The node holds a SocketAppProxy:
+- its JSON-RPC *client* dials the app and calls `State.CommitBlock`,
+  `State.GetSnapshot`, `State.Restore`;
+- its JSON-RPC *server* listens for the app's `Babble.SubmitTx` and feeds
+  the submit channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+
+from ..hashgraph import Block
+from ..utils.codec import b64d, b64e
+from .jsonrpc import JSONRPCClient, JSONRPCServer
+from .proxy import AppProxy
+
+
+class SocketAppProxy(AppProxy):
+    def __init__(
+        self,
+        client_addr: str,
+        bind_addr: str,
+        timeout: float = 5.0,
+        logger: logging.Logger = None,
+    ):
+        self.logger = logger or logging.getLogger("socket_app_proxy")
+        self._submit_ch: "queue.Queue[bytes]" = queue.Queue()
+        self.client = JSONRPCClient(client_addr, timeout=timeout)
+        self.server = JSONRPCServer(bind_addr)
+        self.server.register("Babble.SubmitTx", self._handle_submit_tx)
+        self.server.start()
+
+    @property
+    def bind_addr(self) -> str:
+        return self.server.addr
+
+    def _handle_submit_tx(self, param) -> bool:
+        self._submit_ch.put(b64d(param))
+        return True
+
+    # ---- AppProxy interface -------------------------------------------
+
+    def submit_ch(self) -> "queue.Queue[bytes]":
+        return self._submit_ch
+
+    def commit_block(self, block: Block) -> bytes:
+        result = self.client.call("State.CommitBlock", block.to_json())
+        self.logger.debug(
+            "CommitBlock round_received=%s", block.round_received()
+        )
+        return b64d(result)
+
+    def get_snapshot(self, block_index: int) -> bytes:
+        return b64d(self.client.call("State.GetSnapshot", block_index))
+
+    def restore(self, snapshot: bytes) -> bytes:
+        return b64d(self.client.call("State.Restore", b64e(snapshot)))
+
+    def close(self) -> None:
+        self.client.close()
+        self.server.close()

@@ -1,0 +1,83 @@
+"""App-side socket proxy (reference: src/proxy/socket/babble/ —
+socket_babble_proxy.go:11-56, socket_babble_proxy_client.go:10-52,
+socket_babble_proxy_server.go:71-117).
+
+The application holds a SocketBabbleProxy:
+- its JSON-RPC *client* dials the node and calls `Babble.SubmitTx`;
+- its JSON-RPC *server* exposes `State.CommitBlock`, `State.GetSnapshot`,
+  `State.Restore`, forwarding to the app's ProxyHandler.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..hashgraph import Block
+from ..utils.codec import b64d, b64e
+from .jsonrpc import JSONRPCClient, JSONRPCServer
+from .proxy import ProxyHandler
+
+
+class SocketBabbleProxy:
+    def __init__(
+        self,
+        node_addr: str,
+        bind_addr: str,
+        handler: ProxyHandler,
+        timeout: float = 5.0,
+        logger: logging.Logger = None,
+    ):
+        self.logger = logger or logging.getLogger("socket_babble_proxy")
+        self.handler = handler
+        self.client = JSONRPCClient(node_addr, timeout=timeout)
+        self.server = JSONRPCServer(bind_addr)
+        self.server.register("State.CommitBlock", self._handle_commit)
+        self.server.register("State.GetSnapshot", self._handle_snapshot)
+        self.server.register("State.Restore", self._handle_restore)
+        self.server.start()
+
+    @property
+    def bind_addr(self) -> str:
+        return self.server.addr
+
+    # ---- server handlers (node -> app) --------------------------------
+
+    def _handle_commit(self, param) -> str:
+        block = Block.from_json(param)
+        return b64e(self.handler.commit_handler(block))
+
+    def _handle_snapshot(self, param) -> str:
+        return b64e(self.handler.snapshot_handler(int(param)))
+
+    def _handle_restore(self, param) -> str:
+        return b64e(self.handler.restore_handler(b64d(param)))
+
+    # ---- client (app -> node) -----------------------------------------
+
+    def submit_tx(self, tx: bytes) -> None:
+        ok = self.client.call("Babble.SubmitTx", b64e(tx))
+        if not ok:
+            raise RuntimeError("SubmitTx rejected")
+
+    def close(self) -> None:
+        self.client.close()
+        self.server.close()
+
+
+class DummySocketClient:
+    """The reference chat-demo app over sockets
+    (reference: src/proxy/dummy/socket_dummy.go)."""
+
+    def __init__(
+        self, node_addr: str, bind_addr: str, logger: logging.Logger = None
+    ):
+        from .dummy import State
+
+        self.state = State(logger)
+        self.proxy = SocketBabbleProxy(node_addr, bind_addr, self.state, logger=logger)
+
+    def submit_tx(self, tx: bytes) -> None:
+        self.proxy.submit_tx(tx)
+
+    def close(self) -> None:
+        self.proxy.close()

@@ -1,0 +1,185 @@
+"""HTTP status service: `GET /stats` and `GET /block/{index}`
+(reference: src/service/service.go:28-63), plus live profiling under
+`/debug/` — the counterpart of the reference's net/http/pprof handlers
+riding the service mux (reference: cmd/babble/main.go:4):
+
+- GET /debug/stacks          — all-thread stack dump (goroutine-profile analog)
+- GET /debug/profile?seconds=N — sample every thread's stack for N seconds
+  (<=60) and return the hottest frames/stacks as text
+
+Runs a daemon ThreadingHTTPServer so `serve()` mirrors the reference's
+`go Service.Serve()` composition (babble.go:203-209) without blocking the
+node loops.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .utils.netaddr import split_hostport
+
+
+def thread_stacks() -> str:
+    """One stack trace per live thread, goroutine-dump style."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"thread {names.get(ident, '?')} ({ident}):")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+_profile_lock = threading.Lock()
+
+
+def profile_process(seconds: float, hz: float = 100.0) -> str:
+    """Sampling profiler over EVERY thread in the process: collect each
+    thread's current stack `hz` times a second for `seconds` via
+    sys._current_frames (cProfile's tracing hooks only instrument the
+    installing thread, which would profile the HTTP handler instead of
+    the node), then render the hottest frames and hottest whole stacks —
+    the CPU-profile analog of the reference's pprof endpoint. One
+    profile at a time."""
+    if not _profile_lock.acquire(blocking=False):
+        return "profile already running\n"
+    try:
+        me = threading.get_ident()
+        frame_hits: dict = {}
+        stack_hits: dict = {}
+        period = 1.0 / hz
+        deadline = time.monotonic() + seconds
+        samples = 0
+        while time.monotonic() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 40:
+                    code = f.f_code
+                    stack.append(
+                        f"{code.co_filename}:{f.f_lineno}({code.co_name})"
+                    )
+                    f = f.f_back
+                if not stack:
+                    continue
+                frame_hits[stack[0]] = frame_hits.get(stack[0], 0) + 1
+                key = tuple(stack)
+                stack_hits[key] = stack_hits.get(key, 0) + 1
+            samples += 1
+            time.sleep(period)
+        out = [f"{samples} samples over {seconds:.1f}s at {hz:.0f} Hz\n"]
+        out.append("hottest frames (samples, location):")
+        for loc, n in sorted(frame_hits.items(), key=lambda kv: -kv[1])[:40]:
+            out.append(f"  {n:6d}  {loc}")
+        out.append("\nhottest stacks:")
+        for stack, n in sorted(stack_hits.items(), key=lambda kv: -kv[1])[:5]:
+            out.append(f"  {n} samples:")
+            out.extend(f"    {line}" for line in stack[:20])
+        return "\n".join(out) + "\n"
+    finally:
+        _profile_lock.release()
+
+
+class Service:
+    def __init__(
+        self,
+        bind_address: str,
+        node,
+        logger: Optional[logging.Logger] = None,
+        remote_debug: bool = False,
+    ):
+        self.bind_address = bind_address
+        self.node = node
+        self.logger = logger or logging.getLogger("babble.service")
+        # /debug/* can hold the profiler's GIL-contending sampling loop
+        # for up to 60s per request — loopback-only unless explicitly
+        # opted in (the stats port is often network-reachable; pprof
+        # exposure is restricted the same way in production Go services)
+        self.remote_debug = remote_debug
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def debug_allowed(self, client_ip: str) -> bool:
+        return self.remote_debug or client_ip in (
+            "127.0.0.1", "::1", "::ffff:127.0.0.1",
+        )
+
+    def serve(self) -> None:
+        """Start serving in a background thread (idempotent)."""
+        if self._httpd is not None:
+            return
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                ctype = "application/json"
+                try:
+                    if self.path == "/stats":
+                        body = json.dumps(service.node.get_stats()).encode()
+                    elif self.path.startswith("/block/"):
+                        index = int(self.path[len("/block/"):])
+                        body = json.dumps(
+                            service.node.get_block(index).to_json()
+                        ).encode()
+                    elif self.path.startswith("/debug/"):
+                        if not service.debug_allowed(self.client_address[0]):
+                            self.send_error(
+                                403, "debug endpoints are loopback-only"
+                            )
+                            return
+                        if self.path == "/debug/stacks":
+                            body = thread_stacks().encode()
+                            ctype = "text/plain"
+                        elif self.path.startswith("/debug/profile"):
+                            q = parse_qs(urlparse(self.path).query)
+                            secs = float(q.get("seconds", ["5"])[0])
+                            body = profile_process(
+                                min(max(secs, 0.1), 60.0)
+                            ).encode()
+                            ctype = "text/plain"
+                        else:
+                            self.send_error(404)
+                            return
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — surface as HTTP 500
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                service.logger.debug("service: " + fmt, *args)
+
+        host, port = split_hostport(self.bind_address)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="babble-service", daemon=True
+        )
+        self._thread.start()
+        self.logger.debug("Service serving on %s", self.local_addr())
+
+    def local_addr(self) -> str:
+        if self._httpd is None:
+            return self.bind_address
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,15 @@
+from .grid import DagGrid, GridUnsupported, grid_from_hashgraph, synthetic_grid, build_levels
+from .engine import PassResults, run_passes, run_consensus_device
+from . import kernels
+
+__all__ = [
+    "DagGrid",
+    "GridUnsupported",
+    "grid_from_hashgraph",
+    "synthetic_grid",
+    "build_levels",
+    "PassResults",
+    "run_passes",
+    "run_consensus_device",
+    "kernels",
+]

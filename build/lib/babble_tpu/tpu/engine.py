@@ -446,11 +446,8 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
     path behind node.Config.mesh_devices."""
     from ..common import StoreErr, StoreErrType, is_store_err
     from ..hashgraph import RoundInfo, PendingRound
-    import time as _time
 
-    _t0 = _time.perf_counter()
     grid = grid_from_hashgraph(hg)
-    _stage_s = _time.perf_counter() - _t0
     if grid.e == 0:
         hg.process_decided_rounds()
         hg.process_sig_pool()
@@ -458,23 +455,10 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
     if mesh is not None:
         from .sharded import sharded_frontier_passes, sharded_run_passes
 
-        _t1 = _time.perf_counter()
         if _frontier_safe(grid):
             res = sharded_frontier_passes(mesh, grid)
         else:
             res = sharded_run_passes(mesh, grid)
-        # per-call staging-vs-device breakdown for the mesh product path
-        # (VERDICT r4 #8): the one-shot restage is O(E) host work per call
-        # — the counters make its cost visible in /stats and in the
-        # multichip dryrun so the scaling model is measured, not asserted
-        hg._mesh_stage_seconds = getattr(hg, "_mesh_stage_seconds", 0.0) + _stage_s
-        hg._mesh_device_seconds = (
-            getattr(hg, "_mesh_device_seconds", 0.0) + _time.perf_counter() - _t1
-        )
-        hg._mesh_staged_events = grid.e
-        # calls LAST: /stats readers gate on it lock-free, so the other
-        # counters must exist before it becomes nonzero
-        hg._mesh_calls = getattr(hg, "_mesh_calls", 0) + 1
     elif _frontier_safe(grid):
         res = run_frontier_passes(grid, d_max=d_max)
     else:

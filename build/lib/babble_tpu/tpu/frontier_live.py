@@ -1,0 +1,319 @@
+"""Frontier-based incremental device consensus: the flagship round-frontier
+pipeline (babble_tpu/tpu/frontier.py) with its INV/chain tables maintained
+INCREMENTALLY across append trains — the live-engine counterpart of the
+one-shot pipeline's staging, converting bench.py's amortization premise
+("a live engine maintains INV alongside la/fd") into code.
+
+Why appends are cheap here: INV[c, p, v] (first chain-c index whose
+p-coordinate reaches v) is a suffix-min closure over per-event scatter
+entries, and lastAncestors are non-decreasing along a chain — so appending
+an event touches exactly its own chain's (N, L) plane: one scatter-min of
+its index at v = la[e, p] per coordinate, then the (idempotent) suffix-min
+re-closure. rows_by gains one cell. Nothing else about prior events ever
+changes: frontier values X(r)[c] only ever FILL IN (an existing event's
+round is immutable), so rerunning the r_cap-step walk over the maintained
+tables reproduces the one-shot pipeline bit-for-bit — gated in
+bench_incremental.py against engine.run_passes on every replay.
+
+Unlike the level-scan incremental engine (incremental.py), whose sequential
+axis is the train's dependency-level table (~chain depth), this engine's
+only sequential axis is the ROUND count — per train: O(1) scatters +
+suffix-min + the frontier walk + fame/received. No per-event device work at
+all.
+
+Divergence latches (host falls back to the level-scan engine / host
+engine): `l_over` (a chain outgrew the index axis), `r_over` (rounds
+outgrew the walk window), `frozen_violation` (a witness registered into a
+round whose fame the previous state had fully decided — the host engine
+freezes such rounds forever, reference: src/hashgraph/hashgraph.go:852-947
+processing discipline, and recomputation would unblock receptions the host
+holds back).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import jax.lax
+
+from .frontier import frontier_post, frontier_x0, make_walk_step, suffix_min
+from .kernels import (
+    MAX_INT32,
+    _decide_fame_tables,
+    _fame_setup_tables,
+    _received_tables_from,
+    received_search,
+)
+from .incremental import Train
+
+# rounds recomputed per decide call: must cover the unsettled suffix (the
+# top ~2 rounds whose frontier entries are still filling) plus every round
+# a single train can add. 8192-event trains at 64 validators add ~16.
+R_WIN = 24
+
+
+class FrState(NamedTuple):
+    """Device-resident frontier-engine state (E_cap rows, N chains x L
+    indexes, r_cap rounds)."""
+
+    inv: jax.Array  # (N, N, L) f32 threshold tables (maintained)
+    rows_by: jax.Array  # (N, L) int32 chain tables (-1 = none)
+    x_hist: jax.Array  # (r_cap, N) int32 frontier history (L = sentinel)
+    dirty: jax.Array  # (N,) bool — chains appended to since the last walk
+    la: jax.Array  # (E_cap, N) int32
+    creator: jax.Array  # (E_cap,) int32
+    index: jax.Array  # (E_cap,) int32 (-1 = empty row)
+    lamport: jax.Array  # (E_cap,) int32 (host-maintained, shipped per train)
+    coin: jax.Array  # (E_cap,) bool
+    rounds: jax.Array  # (E_cap,) int32
+    witness: jax.Array  # (E_cap,) bool
+    received: jax.Array  # (E_cap,) int32
+    wtable: jax.Array  # (r_cap, N) int32
+    fame_decided: jax.Array  # (r_cap, N) bool
+    famous: jax.Array  # (r_cap, N) bool
+    rounds_decided: jax.Array  # (r_cap,) bool
+    last_round: jax.Array  # () int32
+    count: jax.Array  # () int32
+    l_over: jax.Array  # () bool — chain index axis exhausted
+    r_over: jax.Array  # () bool — walk round window exhausted
+    frozen_violation: jax.Array  # () bool — late witness in a decided round
+
+
+def init_frontier_state(n: int, e_cap: int, l_cap: int, r_cap: int) -> FrState:
+    return FrState(
+        inv=jnp.full((n, n, l_cap), float(l_cap), jnp.float32),
+        rows_by=jnp.full((n, l_cap), -1, jnp.int32),
+        x_hist=jnp.full((r_cap, n), l_cap, jnp.int32),
+        dirty=jnp.zeros((n,), bool),
+        la=jnp.full((e_cap, n), -1, jnp.int32),
+        creator=jnp.zeros((e_cap,), jnp.int32),
+        index=jnp.full((e_cap,), -1, jnp.int32),
+        lamport=jnp.full((e_cap,), -1, jnp.int32),
+        coin=jnp.zeros((e_cap,), bool),
+        rounds=jnp.full((e_cap,), -1, jnp.int32),
+        witness=jnp.zeros((e_cap,), bool),
+        received=jnp.full((e_cap,), -1, jnp.int32),
+        wtable=jnp.full((r_cap, n), -1, jnp.int32),
+        fame_decided=jnp.zeros((r_cap, n), bool),
+        famous=jnp.zeros((r_cap, n), bool),
+        rounds_decided=jnp.zeros((r_cap,), bool),
+        last_round=jnp.int32(0),
+        count=jnp.int32(0),
+        l_over=jnp.bool_(False),
+        r_over=jnp.bool_(False),
+        frozen_violation=jnp.bool_(False),
+    )
+
+
+def _append_train(state: FrState, train: Train) -> FrState:
+    """Stage a train's rows and close the INV/chain tables over them.
+    O(train) scatters + one suffix-min re-closure; no per-event loop.
+
+    No first-descendant matrix is maintained and the train's fd delta
+    stream (upd_row/col/val) is IGNORED: fd rows are derived on demand
+    from INV via fd[e, p] == INV[p, creator(e), index(e)] — this removes
+    the largest append cost (a ~0.5M-entry scatter per 8k-event train)
+    and the host-side delta staging entirely."""
+    e_cap, n = state.la.shape
+    l = state.rows_by.shape[1]
+
+    valid = train.rows >= 0
+    tgt = jnp.where(valid, train.rows, e_cap)
+
+    la = state.la.at[tgt].set(train.la_rows, mode="drop")
+    creator = state.creator.at[tgt].set(train.creator, mode="drop")
+    index = state.index.at[tgt].set(train.index, mode="drop")
+    lamport = state.lamport.at[tgt].set(train.lamport, mode="drop")
+    coin = state.coin.at[tgt].set(train.coin, mode="drop")
+
+    # chain tables: one cell per appended event
+    c_t = jnp.where(valid, train.creator, n)
+    ci = jnp.clip(train.index, 0, l - 1)
+    rows_by = state.rows_by.at[c_t, ci].set(train.rows, mode="drop")
+    l_over = state.l_over | jnp.any(valid & (train.index >= l))
+
+    # INV maintenance: scatter-min each new event's per-creator index at
+    # value slot v = la[e, p] on its own chain's plane, then re-close with
+    # the (idempotent) suffix-min — exactly build_inv's construction,
+    # restricted to the appended entries.
+    #
+    # Delta masking: a coordinate that did not advance past the
+    # self-parent's is already covered by the self-parent's (smaller)
+    # index at an equal-or-higher value slot, so only advanced coordinates
+    # scatter — ~4x fewer updates (TPU scatter cost is per-update).
+    kb = train.rows.shape[0]
+    la_rows = train.la_rows  # (KB, N)
+    sp_in = train.sp_pos >= 0
+    la_sp_pre = state.la.at[
+        jnp.where(train.sp_row >= 0, train.sp_row, e_cap)
+    ].get(mode="fill", fill_value=-1)  # (KB, N)
+    la_sp_train = train.la_rows[jnp.maximum(train.sp_pos, 0)]
+    la_sp = jnp.where(sp_in[:, None], la_sp_train, la_sp_pre)
+    advanced = la_rows > la_sp
+
+    v_slot = jnp.where(
+        (la_rows >= 0) & advanced, jnp.minimum(la_rows, l - 1), l
+    )
+    c_b = jnp.broadcast_to(c_t[:, None], (kb, n))
+    p_b = jnp.broadcast_to(jnp.arange(n)[None, :], (kb, n))
+    idx_b = jnp.broadcast_to(
+        train.index.astype(jnp.float32)[:, None], (kb, n)
+    )
+    inv = state.inv.at[c_b, p_b, v_slot].min(idx_b, mode="drop")
+    inv = suffix_min(inv, jnp.float32(l), axis=2)
+
+    dirty = state.dirty.at[c_t].set(True, mode="drop")
+    count = state.count + jnp.sum(valid, dtype=jnp.int32)
+    return state._replace(
+        inv=inv, rows_by=rows_by, la=la, creator=creator,
+        index=index, lamport=lamport, coin=coin, count=count, l_over=l_over,
+        dirty=dirty,
+    )
+
+
+def _decide(state: FrState, super_majority: int, n_participants: int) -> FrState:
+    """Warm-start windowed frontier walk + fame + received over the
+    maintained tables.
+
+    Frontier entries X(r)[c] are WRITE-ONCE (an existing event's round is
+    immutable; appends can only fill sentinel entries, and only on the
+    appending chain), so rows below
+        floor = min over dirty chains of their first-sentinel round
+    cannot change: recompute only R_WIN rows from there, seeded with the
+    stored X(floor-1). The result is bit-identical to the full walk —
+    differential-gated in tests/test_incremental.py and
+    bench_incremental.py."""
+    e_cap, n = state.la.shape
+    l = state.rows_by.shape[1]
+    r_cap = state.wtable.shape[0]
+    sent = jnp.int32(l)
+    r_win = min(R_WIN, r_cap)
+
+    # X(r)[c] is non-decreasing in r, so "is sentinel" is monotone: the
+    # first sentinel row per chain is just the count of non-sentinel rows
+    first_sent = jnp.sum(state.x_hist < sent, axis=0).astype(jnp.int32)
+    floor = jnp.min(jnp.where(state.dirty, first_sent, r_cap))
+    floor = jnp.clip(floor, 0, r_cap - r_win)
+
+    # seed: X(start) where start = max(floor-1, 0) — row start is final
+    # for every chain that could change (or the X(0) base case), and the
+    # scan emits the PRE-step carry, so emission k lands at row start+k
+    start = jnp.maximum(floor - 1, 0)
+    prev = jax.lax.dynamic_slice(state.x_hist, (start, 0), (1, n))[0]
+    x_seed = jnp.where(floor == 0, frontier_x0(state.rows_by), prev)
+
+    step = make_walk_step(
+        state.inv, state.rows_by, None, state.la, super_majority,
+        m0_mode="binsearch",
+    )
+
+    def body(x_cur, _):
+        return step(x_cur), x_cur
+
+    x_last, x_new = jax.lax.scan(body, x_seed, None, length=r_win)
+    x_hist = jax.lax.dynamic_update_slice(state.x_hist, x_new, (start, 0))
+    # the window must reach past the top round: X(start + r_win) still
+    # holding frontier entries means a round exists beyond the recomputed
+    # rows
+    r_over = state.r_over | jnp.any(x_last < sent)
+
+    fr = frontier_post(
+        x_hist, state.rows_by, state.creator, state.index, state.index - 1
+    )
+
+    # fame + received from per-witness tables; fd rows come from INV
+    # (fd[e, p] == INV[p, creator(e), index(e)]) instead of a maintained
+    # fd matrix
+    wtable = fr.witness_table
+    wvalid = wtable >= 0
+    wrows = jnp.maximum(wtable, 0)
+    la_w = state.la[wrows]  # (R, N, N)
+    idx_w = jnp.where(wvalid, state.index[wrows], MAX_INT32)  # (R, N)
+    coin_w = state.coin[wrows]
+    vv = jnp.arange(l)
+    oh_w = (
+        jnp.clip(idx_w, 0, l - 1)[:, :, None] == vv[None, None, :]
+    ).astype(jnp.float32)  # (R, C, V)
+    fdw = jnp.einsum(
+        "rcv,pcv->rcp", oh_w, state.inv,
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)  # (R, C, P)
+    fd_w = jnp.where(
+        wvalid[:, :, None] & (fdw < sent), fdw, MAX_INT32
+    )
+
+    ss, votes0, wvalid, coin_w = _fame_setup_tables(
+        wvalid, la_w, fd_w, idx_w, coin_w, super_majority
+    )
+    fame = _decide_fame_tables(
+        ss, votes0, wvalid, coin_w, fr.last_round,
+        super_majority, n_participants, r_cap + 2,
+    )
+    min_la, famous_count, i_ok, horizon = _received_tables_from(
+        wvalid, la_w, fame.decided, fame.famous, fame.rounds_decided,
+        fr.last_round,
+    )
+    received = received_search(
+        state.index, state.creator, fr.rounds, min_la, famous_count,
+        i_ok, horizon,
+    )
+
+    # a witness whose round the PREVIOUS state had fully fame-decided:
+    # the host engine freezes that round (its fame stays undefined and it
+    # blocks receptions); recomputation silently unblocks — latch it
+    new_w = fr.witness & ~state.witness
+    wr = jnp.clip(fr.rounds, 0, r_cap - 1)
+    prev_rd = state.rounds_decided[wr]
+    frozen_violation = state.frozen_violation | jnp.any(
+        new_w & prev_rd & (fr.rounds >= 0)
+    )
+    r_over = r_over | (fr.last_round + 2 >= r_cap)
+
+    return state._replace(
+        x_hist=x_hist, dirty=jnp.zeros_like(state.dirty),
+        rounds=fr.rounds, witness=fr.witness, received=received,
+        wtable=fr.witness_table,
+        fame_decided=fame.decided, famous=fame.famous,
+        rounds_decided=fame.rounds_decided, last_round=fr.last_round,
+        r_over=r_over, frozen_violation=frozen_violation,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("super_majority", "n_participants"),
+    donate_argnames=("state",),
+)
+def frontier_train_step(
+    state: FrState, train: Train, super_majority: int, n_participants: int
+) -> FrState:
+    """One whole append train + walk + fame + received, as a single device
+    program with donated (in-place) state."""
+    return _decide(
+        _append_train(state, train), super_majority, n_participants
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("super_majority", "n_participants"),
+    donate_argnames=("state",),
+)
+def frontier_multi_train(
+    state: FrState, stacked: Train, super_majority: int, n_participants: int
+) -> FrState:
+    """K stacked trains appended in one device program (scan of the append
+    body — appends don't need intermediate decisions), then one walk +
+    fame + received. Bit-identical to per-train steps: decisions are pure
+    functions of the maintained tables."""
+
+    def body(st, t):
+        return _append_train(st, t), None
+
+    out, _ = jax.lax.scan(body, state, stacked)
+    return _decide(out, super_majority, n_participants)

@@ -1,0 +1,415 @@
+"""Dense device representation of the gossip DAG.
+
+The hashgraph's per-event `lastAncestors` / `firstDescendants` coordinate
+vectors (reference: src/hashgraph/event.go:115-116, hashgraph.go:439-544)
+become two (E, N) int32 matrices; events become rows identified by
+(creator position, per-creator index) — the wire-int encoding
+(reference: src/hashgraph/event.go:353-368) promoted to grid coordinates.
+No hashes live on device; the only hash-derived value shipped is the
+precomputed coin-round bit per event (reference:
+src/hashgraph/hashgraph.go:1526-1535), which is consensus-critical.
+
+Events are laid out in *topological levels*: level(e) = 1 + max(level of
+parents). Ancestors always occupy strictly lower levels, and a creator has
+at most one event per level (the self-parent sits one level down), so each
+level holds <= N events and the whole DAG processes as a scan over levels
+with all within-level work vectorized — the TPU-native replacement for the
+reference's per-event recursion.
+
+Parents that live *outside* the grid (root self-parents, root `others`
+entries created by fast-sync Reset — reference: src/hashgraph/root.go:92-96
+— or already-determined events outside an incremental window) are resolved
+host-side into per-event external metadata (`ext_sp_round`, `ext_op_round`,
+`fixed_round`, lamport equivalents), mirroring the root cases of the
+reference round/lamport recursion (reference: src/hashgraph/
+hashgraph.go:205-278,325-379). This makes the device path valid on any
+hashgraph state, including after Reset/fast-sync.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAX_INT32 = 2**31 - 1
+MIN_INT32 = -(2**31)
+
+
+@dataclass
+class DagGrid:
+    """Host-side numpy staging of one consensus batch."""
+
+    n: int  # validators
+    e: int  # events
+    super_majority: int
+    creator: np.ndarray  # (E,) int32 peer position
+    index: np.ndarray  # (E,) int32 per-creator sequence number
+    self_parent: np.ndarray  # (E,) int32 event row, -1 = outside grid
+    other_parent: np.ndarray  # (E,) int32 event row, -1 = none/outside grid
+    last_ancestors: np.ndarray  # (E, N) int32
+    first_descendants: np.ndarray  # (E, N) int32 (MAX_INT32 = none)
+    coin_bit: np.ndarray  # (E,) bool
+    # external-parent metadata (used where the parent row is -1):
+    fixed_round: np.ndarray  # (E,) int32: >=0 forces the round (root-attached)
+    ext_sp_round: np.ndarray  # (E,) int32 self-parent round outside grid
+    ext_op_round: np.ndarray  # (E,) int32 other-parent round outside grid (-1 none)
+    ext_sp_lamport: np.ndarray  # (E,) int32
+    ext_op_lamport: np.ndarray  # (E,) int32 (MIN_INT32 = none)
+    fixed_lamport: np.ndarray  # (E,) int32: != MIN_INT32 forces the lamport
+    levels: np.ndarray  # (L, N) int32 event rows, -1 padding
+    num_levels: int
+    hashes: Optional[List[str]] = None  # row -> event hex (host bookkeeping)
+    # per-event (row, col, value) first-descendant writes caused by that
+    # event's insert — the delta stream for the incremental engine
+    fd_update_stream: Optional[List[List[Tuple[int, int, int]]]] = None
+
+    @property
+    def r_base(self) -> int:
+        """Highest externally-supplied round — the starting point of any
+        round numbering inside the grid."""
+        base = 0
+        if self.e:
+            base = max(
+                base,
+                int(self.fixed_round.max(initial=0)),
+                int(self.ext_sp_round.max(initial=0)),
+                int(self.ext_op_round.max(initial=0)),
+            )
+        return base
+
+    @property
+    def r_max(self) -> int:
+        # round(e) <= level(e) + r_base + 1 (a round advance needs at least
+        # one new level); +2 margin for the fame lookahead
+        return self.num_levels + self.r_base + 2
+
+
+class GridUnsupported(Exception):
+    """Raised when a hashgraph state cannot be expressed as a dense grid
+    (an other-parent that is resolvable nowhere) — callers fall back to
+    the CPU engine."""
+
+
+def grid_from_hashgraph(hg) -> DagGrid:
+    """Extract the dense grid from a host Hashgraph's store.
+
+    Handles base and post-reset states: parents covered by roots
+    (self-parent hashes, `others` entries) are folded into the per-event
+    external metadata the same way the host round/lamport recursion
+    resolves them (reference: src/hashgraph/hashgraph.go:205-278)."""
+    from ..hashgraph.hashgraph import middle_bit
+
+    participants = hg.participants.to_peer_slice()
+    n = len(participants)
+
+    roots = {p.pub_key_hex: hg.store.get_root(p.pub_key_hex) for p in participants}
+    roots_by_sp = hg.store.roots_by_self_parent()
+
+    from ..common import StoreErr
+
+    events = []
+    try:
+        for p in participants:
+            # post-reset stores hold no history below the root: enumerate
+            # from the root's self-parent index, not from the beginning of
+            # time (a rolled/reset RollingIndex raises TooLate on skip=-1)
+            skip = roots[p.pub_key_hex].self_parent.index
+            for h in hg.store.participant_events(p.pub_key_hex, skip):
+                events.append(hg.store.get_event(h))
+    except StoreErr as err:
+        # a rolled cache window means part of the history is no longer
+        # reachable as full events — the dense full-DAG grid can't be built
+        raise GridUnsupported(f"store window rolled: {err}") from err
+    events.sort(key=lambda ev: ev.topological_index)
+
+    e_count = len(events)
+    row_of: Dict[str, int] = {ev.hex(): i for i, ev in enumerate(events)}
+
+    creator = np.zeros(e_count, dtype=np.int32)
+    index = np.zeros(e_count, dtype=np.int32)
+    self_parent = np.full(e_count, -1, dtype=np.int32)
+    other_parent = np.full(e_count, -1, dtype=np.int32)
+    la = np.full((e_count, n), -1, dtype=np.int32)
+    fd = np.full((e_count, n), MAX_INT32, dtype=np.int32)
+    coin = np.zeros(e_count, dtype=bool)
+    fixed_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_round = np.full(e_count, -1, dtype=np.int32)
+    ext_op_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
+    ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+    fixed_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+    hashes = [ev.hex() for ev in events]
+
+    for i, ev in enumerate(events):
+        creator[i] = hg.peer_position(ev.creator())
+        index[i] = ev.index()
+        root = roots[ev.creator()]
+        other = root.others.get(ev.hex())
+        sp = ev.self_parent()
+        op = ev.other_parent()
+
+        if sp in row_of:
+            self_parent[i] = row_of[sp]
+        elif sp == root.self_parent.hash:
+            ext_sp_round[i] = root.self_parent.round
+            ext_sp_lamport[i] = root.self_parent.lamport_timestamp
+            # directly attached to the root: round is forced to next_round
+            # (reference: hashgraph.go:207-236)
+            if op == "" or (other is not None and other.hash == op):
+                fixed_round[i] = root.next_round
+        else:
+            raise GridUnsupported(f"self-parent unresolvable: {sp[:18]}…")
+
+        if op != "":
+            if other is not None and other.hash == op:
+                # other-parent covered by the root's `others` map
+                ext_op_round[i] = root.next_round
+                ext_op_lamport[i] = other.lamport_timestamp
+            elif op in row_of:
+                other_parent[i] = row_of[op]
+            elif op in roots_by_sp:
+                opr = roots_by_sp[op]
+                ext_op_round[i] = opr.self_parent.round
+                # mirrors the host lamport cache-miss behavior for root
+                # self-parent hashes (hashgraph.py _lamport_once): stays MIN
+            elif op in hg.frozen_refs:
+                # other-parent below a fast-sync section cut: the FrozenRef
+                # carries its authoritative round. Lamport deliberately
+                # stays MIN — the host recursion consults only its memo
+                # cache and root `others` for lamports (hashgraph.py
+                # _lamport_once), so MIN is the bit-exact mirror; the
+                # section events that actually reference frozen refs carry
+                # pinned lamports anyway (fixed_lamport below).
+                ext_op_round[i] = hg.frozen_refs[op].round
+            else:
+                raise GridUnsupported(f"other-parent unresolvable: {op[:18]}…")
+
+        # already-determined consensus metadata is authoritative, exactly
+        # like the host engine's memo caches (reference: hashgraph.go:36-40)
+        # — critically, post-reset it carries donor section state that a
+        # recompute from the amnesiac base could not reproduce (incomplete
+        # witness sets around the anchor)
+        if ev.round is not None:
+            fixed_round[i] = ev.round
+        if ev.lamport_timestamp is not None:
+            fixed_lamport[i] = ev.lamport_timestamp
+
+        la[i] = [c[0] for c in ev.last_ancestors]
+        fd[i] = [c[0] for c in ev.first_descendants]
+        coin[i] = middle_bit(ev.hex())
+
+    levels, num_levels = build_levels(n, self_parent, other_parent)
+
+    return DagGrid(
+        n=n,
+        e=e_count,
+        super_majority=hg.super_majority,
+        creator=creator,
+        index=index,
+        self_parent=self_parent,
+        other_parent=other_parent,
+        last_ancestors=la,
+        first_descendants=fd,
+        coin_bit=coin,
+        fixed_round=fixed_round,
+        ext_sp_round=ext_sp_round,
+        ext_op_round=ext_op_round,
+        ext_sp_lamport=ext_sp_lamport,
+        ext_op_lamport=ext_op_lamport,
+        fixed_lamport=fixed_lamport,
+        levels=levels,
+        num_levels=num_levels,
+        hashes=hashes,
+    )
+
+
+def build_levels(n: int, self_parent: np.ndarray, other_parent: np.ndarray):
+    """Topological level table: (L, N) of event rows, -1 padded."""
+    e_count = len(self_parent)
+    level = np.zeros(e_count, dtype=np.int64)
+    for i in range(e_count):
+        lv = 0
+        sp = self_parent[i]
+        if sp >= 0:
+            lv = level[sp] + 1
+        op = other_parent[i]
+        if op >= 0:
+            lv = max(lv, level[op] + 1)
+        level[i] = lv
+
+    num_levels = int(level.max(initial=-1)) + 1 if e_count else 0
+    levels = np.full((max(num_levels, 1), n), -1, dtype=np.int32)
+    slot = np.zeros(max(num_levels, 1), dtype=np.int64)
+    for i in range(e_count):
+        lv = level[i]
+        levels[lv, slot[lv]] = i
+        slot[lv] += 1
+    return levels, num_levels
+
+
+def synthetic_grid(
+    n: int,
+    e_count: int,
+    seed: int = 0,
+    zipf_a: float = 0.0,
+    record_fd_updates: bool = False,
+    byzantine_frac: float = 0.0,
+    withhold_span: int = 24,
+) -> DagGrid:
+    """Generate a random gossip DAG the way gossip produces one: each new
+    event is a sync — creator c extends its own chain with an other-parent
+    drawn from another validator's head (Zipf-skewed fan-out when zipf_a>0,
+    reference scenario: BASELINE.json config #3).
+
+    byzantine_frac > 0 gives the first floor(frac*n) validators an
+    adversarial withhold/flush lifecycle (BASELINE.json config #4's
+    "adversarial 1/3-byzantine event graph"): while withholding, a
+    validator's new events are invisible to partner choice (nobody
+    references its head, its own other-parents go stale), then the hidden
+    chain is revealed all at once by an honest event referencing it.
+    Withholding is staggered at n//8 concurrent validators so the visible
+    set keeps a supermajority (the structure mirror of
+    tests/test_byzantine_scale.py's host-path generator).
+
+    Coordinates (lastAncestors/firstDescendants) are built exactly as the
+    host insert path does (reference: src/hashgraph/hashgraph.go:439-544).
+    Used by the offline replay bench and kernel tests; no signatures — the
+    synthetic coin bits are pseudorandom.
+    """
+    rng = np.random.default_rng(seed)
+    super_majority = 2 * n // 3 + 1
+    # per-event (row, col, value) first-descendant cell writes — the exact
+    # delta stream an incremental engine replays (own-cell write excluded;
+    # it rides with the appended row)
+    fd_updates: List[List[Tuple[int, int, int]]] = [[] for _ in range(e_count)]
+
+    creator = np.zeros(e_count, dtype=np.int32)
+    index = np.zeros(e_count, dtype=np.int32)
+    self_parent = np.full(e_count, -1, dtype=np.int32)
+    other_parent = np.full(e_count, -1, dtype=np.int32)
+    la = np.full((e_count, n), -1, dtype=np.int32)
+    fd = np.full((e_count, n), MAX_INT32, dtype=np.int32)
+
+    head = np.full(n, -1, dtype=np.int64)  # validator -> head event row
+    next_index = np.zeros(n, dtype=np.int64)
+    rows_by = [[] for _ in range(n)]  # validator -> [index -> event row]
+
+    if zipf_a > 0:
+        weights = 1.0 / np.arange(1, n + 1) ** zipf_a
+        weights /= weights.sum()
+    else:
+        weights = np.full(n, 1.0 / n)
+
+    n_byz = int(byzantine_frac * n)
+    visible_head = np.full(n, -1, dtype=np.int64)
+    withholding = np.zeros(n, dtype=bool)
+    hidden_since = np.zeros(n, dtype=np.int64)
+
+    # first event per validator, then gossip syncs
+    for i in range(e_count):
+        forced_op = None
+        if i < n:
+            c = i
+            op_row = -1
+        else:
+            c = int(rng.integers(n))
+            if c < n_byz:
+                if (
+                    not withholding[c]
+                    and int(withholding.sum()) < max(n // 8, 1)
+                    and rng.random() < 1.0 / withhold_span
+                ):
+                    withholding[c] = True
+                    hidden_since[c] = next_index[c]
+                elif (
+                    withholding[c]
+                    and next_index[c] - hidden_since[c] >= withhold_span
+                ):
+                    # flush: an honest event reveals the hidden chain
+                    withholding[c] = False
+                    visible_head[c] = head[c]
+                    forced_op = int(head[c])
+                    c = n_byz + int(rng.integers(n - n_byz)) if n_byz < n else c
+            if forced_op is not None:
+                op_row = forced_op
+            else:
+                partner = int(rng.choice(n, p=weights))
+                while partner == c or visible_head[partner] < 0:
+                    partner = int(rng.choice(n, p=weights))
+                op_row = int(visible_head[partner])
+        creator[i] = c
+        index[i] = next_index[c]
+        self_parent[i] = head[c]
+        other_parent[i] = op_row
+
+        # merge parents' lastAncestors
+        sp_row = head[c]
+        if sp_row < 0 and op_row < 0:
+            pass  # stays all -1
+        elif sp_row < 0:
+            la[i] = la[op_row]
+        elif op_row < 0:
+            la[i] = la[sp_row]
+        else:
+            la[i] = np.maximum(la[sp_row], la[op_row])
+        la[i, c] = index[i]
+        fd[i, c] = index[i]
+
+        rows_by[c].append(i)  # before the walk: own fd cell is already set
+
+        # mark first descendants along ancestors' self-parent chains;
+        # amortized O(E*N): each (row, c) cell is written at most once
+        for p in range(n):
+            a = int(la[i, p])
+            while a >= 0:
+                row = rows_by[p][a]
+                if fd[row, c] == MAX_INT32:
+                    fd[row, c] = index[i]
+                    if record_fd_updates:
+                        fd_updates[i].append((row, c, int(index[i])))
+                    a -= 1
+                else:
+                    break
+
+        head[c] = i
+        if not withholding[c]:
+            visible_head[c] = i
+        next_index[c] += 1
+
+    coin = rng.integers(0, 2, size=e_count).astype(bool)
+    levels, num_levels = build_levels(n, self_parent, other_parent)
+
+    # base-root external metadata: first events per creator attach to base
+    # roots (next_round 0, self-parent round/lamport -1)
+    fixed_round = np.where(
+        (self_parent < 0) & (other_parent < 0), 0, -1
+    ).astype(np.int32)
+    ext_sp_round = np.full(e_count, -1, dtype=np.int32)
+    ext_op_round = np.full(e_count, -1, dtype=np.int32)
+    ext_sp_lamport = np.full(e_count, -1, dtype=np.int32)
+    ext_op_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+    fixed_lamport = np.full(e_count, MIN_INT32, dtype=np.int32)
+
+    return DagGrid(
+        n=n,
+        e=e_count,
+        super_majority=super_majority,
+        creator=creator,
+        index=index,
+        self_parent=self_parent,
+        other_parent=other_parent,
+        last_ancestors=la,
+        first_descendants=fd,
+        coin_bit=coin,
+        fixed_round=fixed_round,
+        ext_sp_round=ext_sp_round,
+        ext_op_round=ext_op_round,
+        ext_sp_lamport=ext_sp_lamport,
+        ext_op_lamport=ext_op_lamport,
+        fixed_lamport=fixed_lamport,
+        levels=levels,
+        num_levels=num_levels,
+        fd_update_stream=fd_updates if record_fd_updates else None,
+    )

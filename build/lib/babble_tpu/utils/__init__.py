@@ -1,0 +1,11 @@
+from .codec import canonical_dumps, canonical_loads, b64e, b64d
+from .netaddr import is_unspecified, split_hostport
+
+__all__ = [
+    "canonical_dumps",
+    "canonical_loads",
+    "b64e",
+    "b64d",
+    "split_hostport",
+    "is_unspecified",
+]

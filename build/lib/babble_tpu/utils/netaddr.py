@@ -1,0 +1,21 @@
+"""Host:port address helpers shared by the TCP transport and JSON-RPC
+proxies."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+UNSPECIFIED_HOSTS = ("", "0.0.0.0", "::", "[::]")
+
+
+def split_hostport(addr: str) -> Tuple[str, int]:
+    """Split "host:port" into (host, port). Raises ValueError on a missing
+    or non-numeric port."""
+    host, _, port_s = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"address {addr!r} has no host:port separator")
+    return host, int(port_s)
+
+
+def is_unspecified(host: str) -> bool:
+    return host in UNSPECIFIED_HOSTS

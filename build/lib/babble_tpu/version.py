@@ -1,0 +1,15 @@
+"""Version string (reference: src/version/version.go).
+
+The reference injects the git commit via ldflags; here an environment
+override (BABBLE_TPU_GIT_COMMIT) plays that role for packaged builds.
+"""
+
+import os
+
+MAJOR = 0
+MINOR = 4
+PATCH = 0
+
+git_commit = os.environ.get("BABBLE_TPU_GIT_COMMIT", "")
+
+version = f"{MAJOR}.{MINOR}.{PATCH}" + (f"-{git_commit[:8]}" if git_commit else "")

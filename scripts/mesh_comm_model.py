@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Measured all-gather volume per round-step of the chains-sharded
+frontier walk (VERDICT r4 #6: make the v5e-8 projection arithmetic).
+
+Compiles the sharded walk for a given (N validators, ndev, L window) on
+the virtual CPU mesh, then reads the all-gather shapes OUT OF THE
+COMPILED HLO — measured from the artifact XLA will run, not asserted
+from the source. Prints one JSON line with bytes/step, bytes/dispatch
+and the ICI time model.
+
+Usage:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python scripts/mesh_comm_model.py [N] [ndev] [L] [r_cap]
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+NDEV = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+L = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+R_CAP = int(sys.argv[4]) if len(sys.argv) > 4 else 32
+
+DTYPE_BYTES = {"f32": 4, "s32": 4, "u32": 4, "pred": 1, "s8": 1, "u8": 1,
+               "bf16": 2, "f16": 2, "s64": 8, "u64": 8, "f64": 8}
+
+
+def main():
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh
+
+    from babble_tpu.tpu.sharded import _frontier_walk_fn
+
+    devs = jax.devices("cpu")[:NDEV]
+    mesh = Mesh(np.array(devs), ("shard",))
+    sm = 2 * N // 3 + 1
+    e = N * L  # worst case: every chain full
+
+    fn = _frontier_walk_fn(mesh, "shard", sm, R_CAP, L)
+    import jax.numpy as jnp
+
+    b = N // NDEV
+    lowered = fn.lower(
+        jnp.zeros((N, N, L), jnp.float32),      # inv (sharded over chains)
+        jnp.zeros((N, L), jnp.int32),           # rows_by
+        jnp.zeros((e, N), jnp.int32),           # fd (replicated)
+        jnp.zeros((e, N), jnp.int32),           # la (replicated)
+        jnp.zeros((N,), jnp.int32),             # x0
+    )
+    hlo = lowered.compile().as_text()
+
+    # every all-gather in the compiled module, with its RESULT shape
+    # (HLO prints `%name = s32[256,256]{1,0} all-gather(...)`)
+    gathers = re.findall(r"=\s*(\w+)\[([\d,]+)\][^=\n]*\ball-gather\(", hlo)
+    per_step = []
+    for dtype, shape in gathers:
+        elems = 1
+        for d in shape.split(","):
+            elems *= int(d)
+        per_step.append((dtype, shape, elems * DTYPE_BYTES.get(dtype, 4)))
+
+    # the walk is a scan over R_CAP steps: each textual all-gather inside
+    # the scan body executes once per step
+    step_bytes = sum(b for _, _, b in per_step)
+    out = {
+        "config": f"N={N} validators, ndev={NDEV}, L={L}, r_cap={R_CAP}",
+        "all_gathers_per_step": [
+            {"dtype": d, "shape": s, "bytes": by} for d, s, by in per_step
+        ],
+        "bytes_per_round_step": step_bytes,
+        "bytes_per_dispatch": step_bytes * R_CAP,
+        # v5e ICI ~ 4x 100 GB/s links per chip; one all-gather moves
+        # (ndev-1)/ndev of the result through the ring
+        "ici_us_per_step_at_100GBps": round(step_bytes / 100e9 * 1e6, 2),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

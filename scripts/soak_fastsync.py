@@ -63,13 +63,29 @@ def dump_states(nodes, tag):
             print(
                 f"  node{i}: state={n.get_state().name} "
                 f"block={n.core.get_last_block_index()} "
+                f"app_block={n._app_committed_index} "
                 f"core_locked={n.core_lock.locked()} "
-                f"work_q={n._work.qsize()} sync_err={n.sync_errors} "
+                f"commit_q={n.commit_ch.qsize()} sync_err={n.sync_errors} "
                 f"bounces={n.fast_forward_bounces}",
                 flush=True,
             )
         except Exception as e:  # noqa: BLE001 — a dead node is still a data point
             print(f"  node{i}: <{e}>", flush=True)
+
+
+def check_spread(nodes, tag, limit=200):
+    """Runaway tripwire (VERDICT r4: survivor minting to 33,613 while its
+    peers sat at ~361): no live node's chain may run `limit` blocks past
+    the slowest live node — consensus needs >2/3 participation, so a
+    spread like that means re-minted or fabricated rounds, not speed."""
+    idx = [
+        n.core.get_last_block_index()
+        for n in nodes
+        if n is not None and n.get_state().name != "SHUTDOWN"
+    ]
+    if idx and max(idx) - min(idx) > limit:
+        dump_states(nodes, f"runaway[{tag}]")
+        raise Stall(f"{tag}: runaway chain spread {idx}")
 
 
 def watched_wait(nodes, alive, prox, target, budget, tag):
@@ -78,6 +94,7 @@ def watched_wait(nodes, alive, prox, target, budget, tag):
 
     try:
         bombard_and_wait(alive, prox, target_block=target, timeout_s=budget)
+        check_spread(nodes, tag)
     except AssertionError as e:
         print(f"STALL[{tag}]: {e}", flush=True)
         dump_states(nodes, "stall")
@@ -244,7 +261,71 @@ def scenario_reattach():
         shutdown_nodes(nodes)
 
 
-SCENARIOS = {"chained": scenario_chained, "reattach": scenario_reattach}
+def scenario_snapshot_race():
+    """Fast-forward serving under a SATURATED commit channel (VERDICT r4
+    #2): every donor's app commit is artificially slowed so the hashgraph
+    anchor runs far ahead of the app's committed height. Before the
+    app-height anchor cap, the donor's get_snapshot raced the commit loop
+    ("snapshot N not found") and starved every joiner; with the cap the
+    join must succeed by construction."""
+    from test_fastsync import build_cluster, make_config
+    from test_node import run_nodes, shutdown_nodes
+
+    conf = make_config()
+    nodes, proxies, keys, peer_list, participants, transports = build_cluster(
+        4, conf
+    )
+
+    def slow_commit(state, dt=0.05):
+        orig = state.commit_handler
+
+        def commit(block):
+            time.sleep(dt)
+            return orig(block)
+
+        state.commit_handler = commit
+
+    for prox in proxies[:3]:
+        slow_commit(prox.state)
+    try:
+        run_nodes(nodes[:3])
+        target = 2
+        while True:
+            watched_wait(nodes[:3], nodes[:3], proxies[:3], target, 240, "sat-base")
+            total = sum(i + 1 for i in nodes[0].core.known_events().values())
+            if total > conf.sync_limit + 50:
+                break
+            target += 1
+        # the race window must be OPEN when the joiner arrives: hashgraph
+        # anchors ahead of the app's committed height on some donor
+        lag_open = any(
+            n.core.hg.anchor_block is not None
+            and n.core.hg.anchor_block > n._app_committed_index
+            for n in nodes[:3]
+        )
+        print(f"  snapshot-race window open: {lag_open}", flush=True)
+
+        nodes[3].run_async(True)
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if nodes[3].core.get_last_block_index() >= 0:
+                break
+            time.sleep(0.25)
+        if nodes[3].core.get_last_block_index() < 0:
+            print("STALL[snapshot-race]: joiner never fast-synced", flush=True)
+            dump_states(nodes, "stall")
+            faulthandler.dump_traceback(file=sys.stderr)
+            raise Stall("snapshot-race: joiner starved by commit-lagged donors")
+        check_spread(nodes, "snapshot-race")
+    finally:
+        shutdown_nodes(nodes)
+
+
+SCENARIOS = {
+    "chained": scenario_chained,
+    "reattach": scenario_reattach,
+    "snapshot-race": scenario_snapshot_race,
+}
 
 
 def main() -> int:

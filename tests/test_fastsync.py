@@ -16,6 +16,7 @@ from babble_tpu.proxy import InmemDummyClient
 from test_node import (
     bombard_and_wait,
     check_gossip,
+    load_scale,
     run_nodes,
     shutdown_nodes,
 )
@@ -360,5 +361,67 @@ def test_bootstrap_all_nodes(tmp_path):
         bombard_and_wait(nodes2, proxies2, target_block=base + 2, timeout_s=180)
         check_gossip(nodes2, upto=base + 2)
         nodes = nodes2  # for the finally clause
+    finally:
+        shutdown_nodes(nodes)
+
+
+def test_eviction_livelock_escape():
+    """Round-5 regression: a node whose store has evicted event BODIES its
+    peers' diffs still reference as parents cannot sync incrementally —
+    but its known-events high-water mark still claims those events, so
+    peers never resend them and over_sync_limit never trips (observed as
+    a survivor wedged for 960s with "EventCache ... Not Found" on the
+    same hashes forever). After 3 consecutive missing-parent sync
+    failures the node must flip to CatchingUp and rebuild via
+    fast-forward instead of livelocking."""
+    conf = make_config()
+    nodes, proxies, *_ = build_cluster(4, conf)
+    try:
+        run_nodes(nodes)
+        bombard_and_wait(nodes, proxies, target_block=3, timeout_s=180)
+
+        victim = nodes[0]
+        # surgically induce the livelock: evict a recent event body from
+        # the victim's store while keeping its participant index entry
+        # (exactly what the LRU does when the undetermined backlog
+        # outgrows cache_size). Pick each peer's LAST KNOWN event so every
+        # incoming diff's next event references a missing parent.
+        with victim.core_lock:
+            store = victim.core.hg.store
+            for p in victim.core.participants.to_peer_slice():
+                h, is_root = store.last_event_from(p.pub_key_hex)
+                if not is_root and h in store.event_cache:
+                    del store.event_cache._items[h]
+
+        wedge_block = victim.core.get_last_block_index()
+
+        # traffic must flow for diffs to arrive and fail; recovery = the
+        # victim is committing again past its wedge point on a store that
+        # can serve every chain head (fast_forward reset rebuilt it)
+        deadline = time.monotonic() + 120 * load_scale()
+        recovered = False
+        while time.monotonic() < deadline:
+            proxies[1].submit_tx(f"evict-{time.monotonic()}".encode())
+            if victim.core.get_last_block_index() >= wedge_block + 2:
+                with victim.core_lock:
+                    cur = victim.core.hg.store
+                    healthy = all(
+                        is_root or h in cur.event_cache
+                        for h, is_root in (
+                            cur.last_event_from(p.pub_key_hex)
+                            for p in victim.core.participants.to_peer_slice()
+                        )
+                    )
+                if healthy:
+                    recovered = True
+                    break
+            time.sleep(0.1)
+        assert recovered, (
+            f"victim never recovered from evicted-parent livelock: "
+            f"state={victim.get_state()}, "
+            f"block={victim.core.get_last_block_index()} "
+            f"(wedged at {wedge_block}), "
+            f"missing_parent_syncs={victim._missing_parent_syncs}"
+        )
     finally:
         shutdown_nodes(nodes)
