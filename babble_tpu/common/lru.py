@@ -11,11 +11,24 @@ from typing import Any, Callable, Optional
 
 
 class LRU:
-    def __init__(self, size: int, on_evict: Optional[Callable[[Any, Any], None]] = None):
+    def __init__(
+        self,
+        size: int,
+        on_evict: Optional[Callable[[Any, Any], None]] = None,
+        pin: Optional[Callable[[Any, Any], bool]] = None,
+    ):
         if size <= 0:
             raise ValueError("LRU size must be positive")
         self.size = size
         self.on_evict = on_evict
+        # `pin(key, value) -> True` exempts an entry from eviction (round
+        # 5): evicting an event body that gossip still needs — an
+        # undetermined event, or a parent peers' diffs will reference —
+        # silently corrupts the DAG store and livelocks the node (its
+        # known-events high-water still claims the body, so peers never
+        # resend it). A store that would have to drop pinned state grows
+        # past `size` instead: memory degradation over corruption.
+        self.pin = pin
         self._items: OrderedDict = OrderedDict()
 
     def __len__(self) -> int:
@@ -46,10 +59,32 @@ class LRU:
             return False
         self._items[key] = value
         if len(self._items) > self.size:
-            old_key, old_val = self._items.popitem(last=False)
-            if self.on_evict is not None:
-                self.on_evict(old_key, old_val)
-            return True
+            if self.pin is None:
+                old_key, old_val = self._items.popitem(last=False)
+                if self.on_evict is not None:
+                    self.on_evict(old_key, old_val)
+                return True
+            # bounded victim scan from the oldest end: evict unpinned
+            # entries until back under the bound; pinned entries
+            # encountered are recycled to the back (they ARE hot —
+            # amortizes the scan and keeps the pinned prefix from being
+            # rescanned every add). The budget bounds per-add cost; any
+            # overage it leaves (all probes pinned) is reclaimed by later
+            # adds, whose loop keeps draining while len > size.
+            evicted = False
+            for _ in range(8):
+                if len(self._items) <= self.size:
+                    break
+                old_key = next(iter(self._items))
+                old_val = self._items[old_key]
+                if self.pin(old_key, old_val):
+                    self._items.move_to_end(old_key)
+                    continue
+                del self._items[old_key]
+                if self.on_evict is not None:
+                    self.on_evict(old_key, old_val)
+                evicted = True
+            return evicted
         return False
 
     def remove(self, key) -> bool:

@@ -15,11 +15,20 @@ from .round_info import RoundInfo
 from .store import Store
 
 
+# per-chain tail kept safe from eviction: incoming diff events reference
+# parents this deep during ordinary gossip races (see _pin_event)
+TAIL_PIN = 64
+
+
 class InmemStore(Store):
-    def __init__(self, participants: Peers, cache_size: int):
+    def __init__(self, participants: Peers, cache_size: int, pin_live: bool = True):
+        # pin_live=False for write-through use under a persistent store
+        # (SQLiteStore): evicted bodies are recoverable from disk there,
+        # so the hard cache bound matters more than the pin
         self._cache_size = cache_size
         self._participants = participants
-        self.event_cache = LRU(cache_size)
+        self._pin = self._pin_event if pin_live else None
+        self.event_cache = LRU(cache_size, pin=self._pin)
         self.round_cache = LRU(cache_size)
         self.block_cache = LRU(cache_size)
         self.frame_cache = LRU(cache_size)
@@ -36,6 +45,31 @@ class InmemStore(Store):
 
     def cache_size(self) -> int:
         return self._cache_size
+
+    def _pin_event(self, key: str, ev: Event) -> bool:
+        """LIVE event bodies are exempt from LRU eviction (round 5): a
+        body the store's own known-events high-water still claims, but
+        whose bytes are gone, livelocks the node — peers' diffs reference
+        it as a parent, inserts fail forever, and over_sync_limit never
+        trips because the high-water looks current (observed: a survivor
+        wedged 960s on three evicted bodies). Live =
+        (a) undetermined (no round-received yet: consensus still reads
+            it, and a stall makes the undetermined window outgrow any
+            fixed cache), or
+        (b) within the newest TAIL_PIN of its creator's chain (diff
+            inserts resolve parents this deep during gossip races).
+        When everything in the scan budget is live the cache grows past
+        its bound instead — memory degradation over DAG corruption."""
+        if ev.round_received is None:
+            return True
+        peer = self._participants.by_pub_key.get(ev.creator())
+        if peer is None:
+            return False
+        # single-chain high-water, not known() — the predicate runs per
+        # eviction probe and known() materializes a dict over all N
+        ri = self.participant_events_cache.rim.mapping.get(peer.id)
+        high = ri.get_last_window()[1] if ri is not None else -1
+        return ev.index() > high - TAIL_PIN
 
     def participants(self) -> Peers:
         return self._participants
@@ -183,7 +217,7 @@ class InmemStore(Store):
     def reset(self, roots: Dict[str, Root]) -> None:
         self.roots_by_participant = roots
         self._roots_by_self_parent = None
-        self.event_cache = LRU(self._cache_size)
+        self.event_cache = LRU(self._cache_size, pin=self._pin)
         self.round_cache = LRU(self._cache_size)
         self.consensus_cache = RollingIndex("ConsensusCache", self._cache_size)
         self.participant_events_cache.reset()

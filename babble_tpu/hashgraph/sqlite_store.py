@@ -65,7 +65,7 @@ CREATE TABLE IF NOT EXISTS participants (
 class SQLiteStore(Store):
     def __init__(self, participants: Peers, cache_size: int, path: str, existing_db: bool = False):
         self._path = path
-        self.inmem = InmemStore(participants, cache_size)
+        self.inmem = InmemStore(participants, cache_size, pin_live=False)
         self._need_bootstrap = existing_db
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -78,7 +78,7 @@ class SQLiteStore(Store):
             # participants come from the db, roots re-read from disk
             db_participants = self._db_participants()
             if len(db_participants):
-                self.inmem = InmemStore(db_participants, cache_size)
+                self.inmem = InmemStore(db_participants, cache_size, pin_live=False)
                 for pk in db_participants.to_pub_key_slice():
                     try:
                         self.inmem.roots_by_participant[pk] = self._db_get_root(pk)

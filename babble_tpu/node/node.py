@@ -113,6 +113,7 @@ class Node(NodeStateMachine):
         self.fast_forward_bounces = 0
         self._consecutive_bounces = 0
         self._missing_parent_syncs = 0
+        self._missing_parent_threshold = 3
         # highest block index the APP has committed (proxy.commit_block
         # returned). The hashgraph's anchor can run a full commit channel
         # ahead of this; fast-forward serving must never anchor past it or
@@ -383,17 +384,25 @@ class Node(NodeStateMachine):
             # failures distinguish the livelock from a transient race.
             if _is_missing_parent(e):
                 self._missing_parent_syncs += 1
-                if self._missing_parent_syncs >= 3:
+                if self._missing_parent_syncs >= self._missing_parent_threshold:
                     self.logger.warning(
-                        "sync livelocked on evicted events (%s); "
+                        "sync livelocked on missing events (%s); "
                         "flipping to CatchingUp to rebuild the store", e,
                     )
                     self._missing_parent_syncs = 0
+                    # escape attempts back off: when fast-forward cannot
+                    # help yet (e.g. no anchor above our height), constant
+                    # flipping would itself stall the cluster — the pinned
+                    # store makes this path rare, the backoff makes it calm
+                    self._missing_parent_threshold = min(
+                        self._missing_parent_threshold * 2, 96
+                    )
                     self.set_state(NodeState.CATCHING_UP)
                     return_event.set()
             return
 
         self._missing_parent_syncs = 0
+        self._missing_parent_threshold = 3
         with self.selector_lock:
             self.peer_selector.update_last(peer_addr)
         self.log_stats()
