@@ -84,6 +84,12 @@ class Hashgraph:
         # only non-test mention), so staying 0 is bit-faithful parity
         self.last_committed_round_events = 0
         self.sig_pool: List[BlockSignature] = []
+        # arrival inbox above; per-block-index backlog for signatures whose
+        # block is not here yet (see process_sig_pool's pool discipline)
+        self._sig_backlog: Dict[int, List[BlockSignature]] = {}
+        # backlog indices whose signatures failed verification against a
+        # still-empty state_hash: re-tried only once our commit fills it
+        self._sig_wait_commit: set = set()
         self.consensus_transactions = 0
         # diagnostics: how often fame voting reached a coin round, and how
         # often the coin (event-hash middle bit) actually decided a vote —
@@ -663,21 +669,39 @@ class Hashgraph:
 
     def process_decided_rounds(self) -> None:
         """Map decided rounds onto Frames and Blocks; commit through the
-        callback (reference: src/hashgraph/hashgraph.go:1041-1122)."""
-        processed_index = 0
+        callback (reference: src/hashgraph/hashgraph.go:1041-1122).
+
+        Processing order is SORTED round order, not queue order, and any
+        round at or below last_consensus_round is dropped as settled —
+        both deliberate strengthenings of the reference (which processes
+        its FIFO queue and skips only `index == LastConsensusRound`,
+        hashgraph.go:1049-1063). The reference can rely on queue order
+        because its joiners re-derive everything from live sync; this
+        rebuild's section replay (apply_section) re-queues scrubbed rounds
+        in section TOPOLOGICAL order, where a round-13 event can precede a
+        round-12 event. Processing 13 first advances last_consensus_round
+        past 12, after which an equality skip no longer recognizes the
+        settled anchor round — it was re-minted as a duplicate block at
+        the next free index, shifting the joiner's whole chain one block
+        against the cluster (the round-5 in-suite byte-divergence). A
+        round <= last_consensus_round is materialized by construction
+        (blocks mint in this loop in ascending round order; reset/section
+        replay settle the anchor), so the floor skip can never drop an
+        unmaterialized round."""
+        pending = sorted(self.pending_rounds, key=lambda p: p.index)
+        pos = 0
         try:
-            for pr in self.pending_rounds:
+            while pos < len(pending):
+                pr = pending[pos]
+                if (
+                    self.last_consensus_round is not None
+                    and pr.index <= self.last_consensus_round
+                ):
+                    pos += 1
+                    continue
                 # never process a decided round before all previous rounds
                 if not pr.decided:
                     break
-
-                # skip the base round after a Reset
-                if (
-                    self.last_consensus_round is not None
-                    and pr.index == self.last_consensus_round
-                ):
-                    processed_index += 1
-                    continue
 
                 frame = self.get_frame(pr.index)
 
@@ -695,12 +719,10 @@ class Hashgraph:
                     if self.commit_callback is not None:
                         self.commit_callback(block)
 
-                processed_index += 1
-
-                if self.last_consensus_round is None or pr.index > self.last_consensus_round:
-                    self._set_last_consensus_round(pr.index)
+                pos += 1
+                self._set_last_consensus_round(pr.index)
         finally:
-            self.pending_rounds = self.pending_rounds[processed_index:]
+            self.pending_rounds = pending[pos:]
 
     def get_frame(self, round_received: int) -> Frame:
         """reference: src/hashgraph/hashgraph.go:1125-1231."""
@@ -754,37 +776,128 @@ class Hashgraph:
         self.store.set_frame(res)
         return res
 
+    # ECDSA verifications per process_sig_pool pass. The pass runs under
+    # core_lock on every sync; an unbounded pass (e.g. the burst of
+    # backlogged signatures that all become verifiable the moment a
+    # fast-forward rebuilds the store) stalls the lock past peers' RPC
+    # timeouts and reads as a dead node (round-5 faulthandler capture:
+    # every peer thread queued behind one process_sig_pool walk).
+    SIG_POOL_VERIFY_BUDGET = 512
+
+    def pending_signatures(self) -> int:
+        """Signatures waiting to attach: the arrival inbox plus the
+        per-block backlog (observability + tests)."""
+        return len(self.sig_pool) + sum(
+            len(v) for v in self._sig_backlog.values()
+        )
+
     def process_sig_pool(self) -> None:
         """Attach valid signatures to blocks; advance the anchor block once a
-        block has >1/3 signatures (reference: src/hashgraph/hashgraph.go:1236-1300)."""
-        processed = set()
-        try:
-            for i, bs in enumerate(self.sig_pool):
-                validator_hex = bs.validator_hex()
-                if validator_hex not in self.participants.by_pub_key:
-                    self.logger.warning(
-                        "Unknown validator for block signature: %s", validator_hex
-                    )
-                    continue
-                try:
-                    block = self.store.get_block(bs.index)
-                except StoreErr:
-                    continue
+        block has >1/3 signatures (reference: src/hashgraph/hashgraph.go:1236-1300).
+
+        The pool discipline is deliberately tighter than the reference,
+        which keeps every unprocessed signature in one flat list and
+        re-walks it all — re-verifying the invalid ones — on every pass
+        (hashgraph.go:1240-1297 marks only attached ones processed). Go
+        clusters never feel that; this rebuild's lagging nodes do: a node
+        2,000 blocks behind holds ~8,000 future-block signatures, and an
+        O(pool) walk with store-miss exceptions under core_lock on EVERY
+        sync is a round-5 cluster wedge (observed: joiner pinned at block
+        23 while peers ran to 2,462). So arrivals land in an inbox
+        (`sig_pool`), are routed once into a per-block-index backlog, and
+        each pass touches ONLY indices at or below the store's block
+        height — a far-future signature costs nothing until its block
+        exists. Rules:
+        - unknown validator: dropped (the validator set is static);
+        - block index above our height: backlogged untouched;
+        - block at or below our height but absent locally (pre-anchor gap
+          after a fast-forward, or evicted): dropped — it can never attach;
+        - invalid against a body whose state_hash is still empty:
+          retained, and the bucket is then skipped at zero ECDSA cost
+          until our commit fills the hash (the only event that can change
+          the outcome; peers sign after their commit does). The skip is
+          armed by a FAILED verify, never by the empty hash alone —
+          stateless apps legitimately finalize at state_hash=b"" and
+          their signatures must attach on the first pass;
+        - invalid against a final (state-hashed) body: dropped — an
+          immutable body can never re-validate the signature."""
+        inbox, self.sig_pool = self.sig_pool, []
+        for bs in inbox:
+            if bs.validator_hex() not in self.participants.by_pub_key:
+                self.logger.warning(
+                    "Unknown validator for block signature: %s",
+                    bs.validator_hex(),
+                )
+                continue
+            self._sig_backlog.setdefault(bs.index, []).append(bs)
+            # a new arrival re-opens a wait-committed bucket: the skip
+            # below exists to avoid RE-verifying known failures, and must
+            # not deny a first verification to a fresh signature — for a
+            # stateless app (final state_hash=b"") one corrupt signature
+            # would otherwise wedge the bucket and block valid ones from
+            # ever attaching (code review r5)
+            self._sig_wait_commit.discard(bs.index)
+
+        last_block = self.store.last_block_index()
+        verified = 0
+        for idx in sorted(i for i in self._sig_backlog if i <= last_block):
+            if verified >= self.SIG_POOL_VERIFY_BUDGET:
+                break
+            try:
+                block = self.store.get_block(idx)
+            except StoreErr:
+                self._sig_backlog.pop(idx)
+                self._sig_wait_commit.discard(idx)
+                continue
+            if idx in self._sig_wait_commit and not block.state_hash():
+                # this bucket already failed verification against the
+                # still-empty body; the only event that can change the
+                # outcome is our commit filling state_hash — skip at zero
+                # ECDSA cost until then (code review r5: re-verifying
+                # burned the whole budget on deterministic failures).
+                # NOTE an empty state_hash is NOT itself proof of a
+                # pending commit — stateless apps legitimately finalize
+                # at b"" — which is why entry to this set requires an
+                # actual failed verify, not the falsy hash alone.
+                continue
+            bucket = self._sig_backlog.pop(idx)
+            self._sig_wait_commit.discard(idx)
+            retained: List[BlockSignature] = []
+            failed_on_empty = False
+            updated = False
+            for pos, bs in enumerate(bucket):
+                if verified >= self.SIG_POOL_VERIFY_BUDGET:
+                    retained.extend(bucket[pos:])
+                    break
+                verified += 1
                 if not block.verify(bs):
-                    self.logger.warning("Invalid block signature for block %d", bs.index)
+                    if not block.state_hash():
+                        # may be OUR commit lagging (peers sign after
+                        # theirs fills state_hash): retry after commit
+                        retained.append(bs)
+                        failed_on_empty = True
+                    else:
+                        self.logger.warning(
+                            "Invalid block signature for block %d "
+                            "(validator=%s rr=%d txs=%d)",
+                            idx,
+                            bs.validator_hex()[:12],
+                            block.round_received(),
+                            len(block.transactions()),
+                        )
                     continue
-
                 block.set_signature(bs)
+                updated = True
+            if updated:
                 self.store.set_block(block)
-
                 if len(block.signatures) > self.trust_count and (
                     self.anchor_block is None or block.index() > self.anchor_block
                 ):
                     self.anchor_block = block.index()
-
-                processed.add(i)
-        finally:
-            self.sig_pool = [bs for i, bs in enumerate(self.sig_pool) if i not in processed]
+            if retained:
+                self._sig_backlog[idx] = retained
+                if failed_on_empty:
+                    self._sig_wait_commit.add(idx)
 
     def run_consensus(self) -> None:
         """The full pipeline with per-pass timing logs
@@ -871,6 +984,10 @@ class Hashgraph:
         self._timestamp_cache.clear()
         self.frozen_refs.clear()
         self.reset_floor = None
+        # wait-commit flags describe pre-reset block bodies; the backlog
+        # itself is kept (signatures may attach to replayed blocks) but
+        # every bucket deserves a fresh verification pass against them
+        self._sig_wait_commit.clear()
 
         participants = self.participants.to_peer_slice()
         root_map = {participants[pos].pub_key_hex: root for pos, root in enumerate(frame.roots)}
@@ -956,8 +1073,16 @@ class Hashgraph:
                 seen.add(h)
         events.sort(key=lambda e: e.topological_index)
 
+        # from anchor_round INCLUSIVE: the anchor round's RoundInfo carries
+        # the witness set every post-reset round computation grounds on —
+        # without it, a joiner whose section has no higher decided rounds
+        # recreates round(anchor) empty on first use, every new event
+        # computes round == anchor (strongly_see needs 2/3 of the TRUE
+        # witness set to advance), and consensus freezes at the anchor
+        # forever (round-5 capture: 3,999 of 4,000 backlogged events in
+        # round 22, witness_state {22: (1, 0)})
         rounds: Dict[int, RoundInfo] = {}
-        for r in range(anchor_round + 1, self.store.last_round() + 1):
+        for r in range(anchor_round, self.store.last_round() + 1):
             try:
                 rounds[r] = self.store.get_round(r)
             except StoreErr:

@@ -158,13 +158,41 @@ class Core:
     def sync(self, unknown_events: List[WireEvent]) -> None:
         """Insert a batch of wire events, then record the sync with a new
         self-event whose other-parent is the batch head
-        (reference: src/node/core.go:209-238)."""
+        (reference: src/node/core.go:209-238).
+
+        Stale-head inserts are skipped PER EVENT, not allowed to abort the
+        batch (deliberate deviation from the reference, whose per-peer Go
+        channels rarely interleave): with several peers concurrently
+        pushing overlapping diffs at one node, most batches contain some
+        events the store already holds — and aborting the whole batch on
+        the first one also skips run_consensus, so the node's DAG keeps
+        growing while its pipeline never runs (round-5 joiner freeze:
+        43,000 undetermined events, zero rounds decided, every batch dead
+        on 'Self-parent not last known event'). A duplicate still counts
+        as a valid batch head; an event whose predecessor is genuinely
+        missing (diff computed against newer state) is dropped and will be
+        resent once the predecessor lands. Forks (same self-parent, new
+        body) are also dropped here without poisoning the batch —
+        insert_event still rejects them; they simply never enter the
+        store. A KEY_NOT_FOUND from resolving wire parents, by contrast,
+        still aborts the batch DELIBERATELY: it means this store lost
+        bodies the diff builds on, and the node-level missing-parent
+        escape (node._gossip) needs to see that error to flip the node
+        into CatchingUp and rebuild the store."""
         other_head = ""
-        for k, we in enumerate(unknown_events):
+        for we in unknown_events:
             ev = self.hg.read_wire_info(we)
-            self.insert_event(ev, False)
-            if k == len(unknown_events) - 1:
-                other_head = ev.hex()
+            try:
+                self.insert_event(ev, False)
+            except ValueError as e:
+                if "Self-parent not last known event" not in str(e):
+                    raise
+                try:
+                    self.hg.store.get_event(ev.hex())
+                except Exception:  # noqa: BLE001 — not here: gap or fork
+                    continue
+                # already present: overlapping delivery, still batch head
+            other_head = ev.hex()
         self.add_self_event(other_head)
 
     def prepare_fast_forward(

@@ -114,6 +114,22 @@ class Node(NodeStateMachine):
         self._consecutive_bounces = 0
         self._missing_parent_syncs = 0
         self._missing_parent_threshold = 3
+        # set when flipping to CatchingUp because our OWN store lost event
+        # bodies (the eviction livelock): licenses fast_forward to accept
+        # an own-chain rewind — IF every peer's reported high-water for
+        # our chain confirms the tail never reached them (_peer_acks)
+        self._rewind_ok = False
+        # highest own-chain seq that has ever left this node through a
+        # SUCCESSFUL export (our eager push, a served sync diff, or a
+        # served fast-forward section). An own event above this bound
+        # provably never reached any peer — relays can only carry what an
+        # export put on the wire — so the rewind license is decided from
+        # local evidence, with no dependency on sampling every peer's
+        # sync responses (code review r5 found the sampled-ack version
+        # unsound; the all-peers version then proved liveness-fragile:
+        # one unreachable peer blocked recovery forever)
+        self._last_exported_seq = -1
+        self._export_lock = threading.Lock()
         # highest block index the APP has committed (proxy.commit_block
         # returned). The hashgraph's anchor can run a full commit channel
         # ahead of this; fast-forward serving must never anchor past it or
@@ -121,6 +137,10 @@ class Node(NodeStateMachine):
         # Single writer (the commit loop); racing readers only ever see a
         # slightly stale floor, which is safe (they serve an older anchor).
         self._app_committed_index = -1
+
+        # single-writer (the _babble loop) in-flight outbound exchange
+        # count; GIL-atomic decrement from the finishing gossip thread
+        self._gossip_inflight = 0
 
         self.need_bootstrap = store.need_bootstrap()
         self.set_starting(True)
@@ -137,6 +157,10 @@ class Node(NodeStateMachine):
             self.logger.debug("Bootstrap")
             self.core.bootstrap()
         self.core.set_head_and_seq()
+        # a restored chain was (conservatively) exported by the previous
+        # process — without this floor, a post-restart livelock could
+        # license rewinding a tail peers already hold (code review r5)
+        self._note_export(self.core.seq)
 
     def run_async(self, gossip: bool) -> None:
         self._run_thread = threading.Thread(
@@ -217,13 +241,29 @@ class Node(NodeStateMachine):
             except queue.Empty:
                 continue
             if gossip:
-                proceed = self._pre_gossip()
+                # At most ONE outbound exchange in flight (deliberate
+                # deviation from the reference, node.go:180-196, which
+                # spawns a goroutine per tick): Python threads are
+                # concurrency, not parallelism — overlapping syncs from
+                # one node only lengthen every peer's core_lock queue. A
+                # 5ms tick against a 30ms exchange piles up hundreds of
+                # doomed handler threads cluster-wide until RPCs time out
+                # en masse and lagging peers starve (the round-5 catch-up
+                # wedge). The guard also makes pacing adaptive for free:
+                # the effective gossip interval is max(heartbeat, actual
+                # exchange time).
+                proceed = self._pre_gossip() if self._gossip_inflight == 0 else False
                 if proceed:
                     peer = self.peer_selector.next()
-                    self.go_func(
-                        lambda addr=peer.net_addr: self._gossip(addr, return_event),
-                        name=f"node-{self.id}-gossip",
-                    )
+                    self._gossip_inflight += 1
+
+                    def _exchange(addr=peer.net_addr):
+                        try:
+                            self._gossip(addr, return_event)
+                        finally:
+                            self._gossip_inflight -= 1
+
+                    self.go_func(_exchange, name=f"node-{self.id}-gossip")
             # keep ticking while starting: a fresh joiner has nothing to
             # gossip about (need_gossip False) but must retry its first
             # exchange until one peer answers — stopping the timer here
@@ -270,16 +310,44 @@ class Node(NodeStateMachine):
         resp = SyncResponse(from_id=self.id)
         resp_err: Optional[str] = None
 
-        with self.core_lock:
-            over_sync_limit = self.core.over_sync_limit(cmd.known, self.conf.sync_limit)
+        # The sync-limit check deliberately runs OUTSIDE core_lock: it is
+        # a monotone participant-heights comparison (store reads that are
+        # GIL-atomic; a torn read is at worst slightly stale, which only
+        # delays the verdict by one exchange). The answer is the one RPC a
+        # saturated node must never sit on — a peer that has fallen behind
+        # learns it should fast-forward FROM THIS RESPONSE, and a busy
+        # survivor's lock queue is exactly when the peer is falling behind
+        # fastest (round-5 wedge: the joiner's 5s RPCs timed out behind
+        # the survivors' own sync traffic, so it never learned it was
+        # behind and sat Babbling at block 21 while they ran to 2,552).
+        try:
+            over_sync_limit = self.core.over_sync_limit(
+                cmd.known, self.conf.sync_limit
+            )
+        except Exception:  # noqa: BLE001 — racing a reset/rebuild: retry
+            with self.core_lock:  # on the consistent path
+                over_sync_limit = self.core.over_sync_limit(
+                    cmd.known, self.conf.sync_limit
+                )
         if over_sync_limit:
             self.logger.debug("SyncLimit")
             resp.sync_limit = True
+            try:
+                resp.known = self.core.known_events()
+            except Exception:  # noqa: BLE001 — same racing-reset fallback
+                with self.core_lock:
+                    resp.known = self.core.known_events()
+            rpc.respond(resp, error=None)
+            return
         else:
             try:
                 with self.core_lock:
                     diff = self.core.event_diff(cmd.known)
+                    exported = self.core.seq
                 resp.events = self.core.to_wire(diff)
+                # serving a diff exports our chain up to `exported` —
+                # evidence bound for the rewind license in fast_forward
+                self._note_export(exported)
             except Exception as e:
                 self.logger.error("Calculating Diff: %s", e)
                 resp_err = str(e)
@@ -336,6 +404,10 @@ class Node(NodeStateMachine):
             resp.frame = frame
             resp.section = section
             resp.snapshot = self.proxy.get_snapshot(block.index())
+            # serving a section exports our chain (its events include
+            # ours): evidence bound for the rewind license
+            if section is not None:
+                self._note_export(self.core.seq)
         except Exception as e:
             # full traceback: a donor that cannot serve (missing rounds,
             # evicted events, stale anchors) starves every joiner — the
@@ -347,6 +419,14 @@ class Node(NodeStateMachine):
     # ------------------------------------------------------------------
     # gossip
     # ------------------------------------------------------------------
+
+    def _note_export(self, exported: int) -> None:
+        """Raise the exported-chain bound monotonically. Locked: racing
+        check-then-set from RPC-handler and gossip threads could lower the
+        bound and unsoundly license an own-chain rewind (code review r5)."""
+        with self._export_lock:
+            if exported > self._last_exported_seq:
+                self._last_exported_seq = exported
 
     def _pre_gossip(self) -> bool:
         with self.core_lock:
@@ -397,12 +477,18 @@ class Node(NodeStateMachine):
                     self._missing_parent_threshold = min(
                         self._missing_parent_threshold * 2, 96
                     )
+                    # our own store is the broken party: license the
+                    # own-chain rewind (see fast_forward) — without it the
+                    # node deadlocks between the unservable store and the
+                    # rewind guard
+                    self._rewind_ok = True
                     self.set_state(NodeState.CATCHING_UP)
                     return_event.set()
             return
 
         self._missing_parent_syncs = 0
         self._missing_parent_threshold = 3
+        self._rewind_ok = False  # a full exchange worked: store is servable
         with self.selector_lock:
             self.peer_selector.update_last(peer_addr)
         self.log_stats()
@@ -427,10 +513,15 @@ class Node(NodeStateMachine):
                 self.logger.debug("SyncLimit")
                 return
             diff = self.core.event_diff(known_events)
+            exported = self.core.seq
         wire_events = self.core.to_wire(diff)
         self.trans.eager_sync(
             peer_addr, EagerSyncRequest(from_id=self.id, events=wire_events)
         )
+        # the push left the node: our chain up to `exported` is now
+        # (conservatively) on the wire — evidence bound for the rewind
+        # license in fast_forward
+        self._note_export(exported)
 
     def fast_forward(self) -> None:
         """Catch-up via a peer's anchor block + frame + app snapshot
@@ -464,14 +555,42 @@ class Node(NodeStateMachine):
                 return
             my_frame_idx = self._own_index_in(resp.frame, resp.section)
             if self.core.seq > my_frame_idx:
-                self._count_bounce(
-                    "fast_forward: reset would rewind own chain "
-                    "(seq %d > frame %d) — not actually behind, resuming"
-                    % (self.core.seq, my_frame_idx)
-                )
-                self.set_state(NodeState.BABBLING)
-                self.set_starting(True)
-                return
+                # The rewind guard exists to protect a chain tail the
+                # network has seen: rewinding it re-uses event indexes and
+                # peers permanently reject the chain as a fork. But a node
+                # that flipped here because its OWN store lost bodies
+                # (_rewind_ok — it cannot even build diffs to push) may
+                # hold a tail that never reached anyone; refusing to
+                # rewind then deadlocks it between the two protections
+                # (observed: 999 consecutive bounces on one frozen frame).
+                # The license therefore requires EVIDENCE, not just the
+                # flag: every own event that ever LEFT this node (pushed
+                # diff, served sync, served fast-forward section —
+                # tracked as _last_exported_seq) must sit at or below the
+                # frame. Peers can only hold, and relays can only spread,
+                # what an export put on the wire, so a tail above the
+                # exported bound provably never reached anyone. This is
+                # local evidence: no dependency on sampling every peer's
+                # responses (unsound) or hearing from every peer (blocks
+                # recovery when one is unreachable).
+                if self._rewind_ok and self._last_exported_seq <= my_frame_idx:
+                    self.logger.warning(
+                        "fast_forward: accepting own-chain rewind (seq %d"
+                        " > frame %d) — store is unservable and nothing "
+                        "above own index %d was ever exported; discarding"
+                        " the tail is the only recovery",
+                        self.core.seq, my_frame_idx,
+                        self._last_exported_seq,
+                    )
+                else:
+                    self._count_bounce(
+                        "fast_forward: reset would rewind own chain "
+                        "(seq %d > frame %d) — not actually behind, resuming"
+                        % (self.core.seq, my_frame_idx)
+                    )
+                    self.set_state(NodeState.BABBLING)
+                    self.set_starting(True)
+                    return
             self._consecutive_bounces = 0
             # validate first (no state mutated), THEN restore the app, THEN
             # apply: the restore must precede the apply because the section
@@ -525,7 +644,16 @@ class Node(NodeStateMachine):
             time.sleep(self.conf.heartbeat_timeout)
             return
 
-        self.logger.debug("Fast-Forward OK")
+        self._rewind_ok = False  # the reset rebuilt the store
+        self.logger.info(
+            "Fast-Forward OK: anchor block %d (round_received %d, frame round"
+            " %d, %d frame events, section %s)",
+            validated[0].index(),
+            validated[0].round_received(),
+            validated[1].round,
+            len(validated[1].events),
+            "%d events" % len(validated[2].events) if validated[2] else "none",
+        )
         self.set_state(NodeState.BABBLING)
         self.set_starting(True)
 

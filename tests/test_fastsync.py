@@ -421,7 +421,11 @@ def test_eviction_livelock_escape():
             f"state={victim.get_state()}, "
             f"block={victim.core.get_last_block_index()} "
             f"(wedged at {wedge_block}), "
-            f"missing_parent_syncs={victim._missing_parent_syncs}"
+            f"missing_parent_syncs={victim._missing_parent_syncs}, "
+            f"rewind_ok={victim._rewind_ok}, "
+            f"last_exported_seq={victim._last_exported_seq}, "
+            f"seq={victim.core.seq}, "
+            f"bounces={victim.fast_forward_bounces}"
         )
     finally:
         shutdown_nodes(nodes)

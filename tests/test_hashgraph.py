@@ -465,6 +465,41 @@ class TestConsensusPipeline:
         assert h.anchor_block is None
         assert [b.index() for b in committed] == [0, 1]
 
+    def test_settled_rounds_never_reminted(self):
+        """Round-5 safety regression: a PendingRound at or below
+        last_consensus_round must be dropped, never re-processed — even
+        when the queue is out of round order. The live failure mode: a
+        fast-synced joiner's section replay re-queues scrubbed rounds in
+        section TOPOLOGICAL order; processing round N+1 first advances
+        last_consensus_round past the settled anchor round N, after which
+        the reference-shaped equality skip (`index == last_consensus_round`)
+        no longer recognizes it and round N's frame is re-minted as a
+        duplicate block at the next free index — shifting the joiner's
+        whole chain one block against the cluster (observed in-suite:
+        byte-divergent block 13, RR 12 duplicating block 11)."""
+        from babble_tpu.hashgraph import PendingRound
+
+        h = self.h
+        committed = []
+        h.commit_callback = committed.append
+        h.run_consensus()
+        assert [b.index() for b in committed] == [0, 1]
+        last_block = h.store.last_block_index()
+        lcr = h.last_consensus_round
+        assert lcr == 2
+
+        # stale re-queues of settled rounds, deliberately out of order
+        # (the later round first, as section topological order produces)
+        h.pending_rounds = [PendingRound(lcr, True), PendingRound(lcr - 1, True)]
+        h.process_decided_rounds()
+
+        assert h.store.last_block_index() == last_block, (
+            "settled round was re-minted as a duplicate block"
+        )
+        assert [b.index() for b in committed] == [0, 1]
+        assert h.pending_rounds == []
+        assert h.last_consensus_round == lcr
+
     def test_known(self):
         h = self.h
         participants = h.participants.to_peer_slice()

@@ -130,10 +130,80 @@ def bombard_and_wait(nodes, proxies, target_block, timeout_s=30.0):
             best_min = cur_min
             stop = max(stop, time.monotonic() + budget)
         time.sleep(0.02)
-    raise AssertionError(
+    # post-mortem for the wedge: whatever thread is hogging a core_lock
+    # right now is the reason progress stopped
+    import faulthandler
+    import sys
+
+    faulthandler.dump_traceback(file=sys.stderr)
+    states = []
+    for n in nodes:
+        try:
+            states.append(_node_state(n))
+        except Exception as e:  # noqa: BLE001 — the dump must never
+            states.append({"id": n.id, "dump_error": str(e)})  # eat the
+    raise AssertionError(  # real assertion
         f"no progress for {budget:.0f}s waiting for block {target_block}; "
-        f"indices={[n.core.get_last_block_index() for n in nodes]}"
+        f"indices={[n.core.get_last_block_index() for n in nodes]}\n"
+        f"node states: {states}"
     )
+
+
+def _node_state(n):
+    return {
+        "id": n.id,
+        "state": str(n.get_state()),
+        "block": n.core.get_last_block_index(),
+        "inflight": getattr(n, "_gossip_inflight", None),
+        "timer_set": n.control_timer.set,
+        "starting": n.is_starting(),
+        "syncs": n.sync_requests,
+        "sync_errors": n.sync_errors,
+        "bounces": n.fast_forward_bounces,
+        "tx_pool": len(n.core.transaction_pool),
+        "need_gossip": n.core.need_gossip(),
+        "lcr": n.core.hg.last_consensus_round,
+        "pending": [
+            (pr.index, pr.decided) for pr in n.core.hg.pending_rounds[:8]
+        ],
+        "undetermined": len(n.core.hg.undetermined_events),
+        "round_dist": _round_dist(n.core.hg),
+        "witness_state": _witness_state(n.core.hg),
+        "last_round": n.core.hg.store.last_round(),
+        "blocks": _dump_blocks(
+            [n],
+            max(0, n.core.get_last_block_index() - 3),
+            n.core.get_last_block_index(),
+        )[0][2],
+    }
+
+
+def _round_dist(hg):
+    """Round distribution of (a sample of) the undetermined backlog — a
+    frozen pipeline shows everything piled into one round."""
+    from collections import Counter
+
+    rc = Counter()
+    for h in hg.undetermined_events[:4000]:
+        try:
+            rc[hg.store.get_event(h).round] += 1
+        except Exception:  # noqa: BLE001
+            rc["err"] += 1
+    return dict(rc)
+
+
+def _witness_state(hg):
+    """(witness count, fame-decided count) for the last three rounds."""
+    out = {}
+    last = hg.store.last_round()
+    for r in range(max(0, last - 2), last + 1):
+        try:
+            ri = hg.store.get_round(r)
+            ws = ri.witnesses()
+            out[r] = (len(ws), sum(1 for w in ws if ri.is_decided(w)))
+        except Exception as e:  # noqa: BLE001
+            out[r] = str(e)
+    return out
 
 
 def check_gossip(nodes, from_block=0, upto=None):
@@ -162,8 +232,50 @@ def check_gossip(nodes, from_block=0, upto=None):
             assert other.body.marshal() == ref.body.marshal(), (
                 f"block {i} differs between node {nodes[0].id} and node "
                 f"{node.id}:\n  {ref.body.marshal()!r}\n  vs\n"
-                f"  {other.body.marshal()!r}"
+                f"  {other.body.marshal()!r}\n"
+                f"  positions={[(p, n.id) for p, n in enumerate(nodes)]}\n"
+                f"  dump={_dump_blocks(nodes, from_block, min_last)}\n"
+                f"  frame_diff={_frame_diff(nodes[0], node, ref.round_received())}"
             )
+
+
+def _frame_diff(a, b, rr):
+    """Which parts of two nodes' frames at round `rr` differ: per-position
+    root mismatches (full canonical dicts) and event-list identity."""
+    try:
+        fa = a.core.hg.get_frame(rr)
+        fb = b.core.hg.get_frame(rr)
+    except Exception as e:  # noqa: BLE001
+        return f"unavailable: {e}"
+    ca, cb = fa.to_canonical(), fb.to_canonical()
+    out = []
+    ea = [e["Body"]["Index"] for e in ca["Events"]]
+    eb = [e["Body"]["Index"] for e in cb["Events"]]
+    if ca["Events"] != cb["Events"]:
+        out.append(("events", ea, eb))
+    for pos, (ra, rb) in enumerate(zip(ca["Roots"], cb["Roots"])):
+        if ra != rb:
+            out.append(("root", pos, ra, rb))
+    return out
+
+
+def _dump_blocks(nodes, lo, hi):
+    """Post-mortem: per node (position, id), each block's (index,
+    round_received, frame-hash prefix, tx count) over [lo, hi]."""
+    out = []
+    for p, n in enumerate(nodes):
+        rows = []
+        for i in range(lo, hi + 1):
+            try:
+                b = n.get_block(i)
+                rows.append(
+                    (i, b.round_received(), b.frame_hash().hex()[:8],
+                     len(b.transactions()))
+                )
+            except Exception as e:  # noqa: BLE001
+                rows.append((i, str(e)))
+        out.append((p, n.id, rows))
+    return out
 
 
 def gossip(nodes, proxies, target_block, shutdown=True, timeout_s=30.0):

@@ -67,7 +67,10 @@ def test_insert_events_with_block_signatures():
     h.store.get_event(index["e21"])  # recorded
     h.process_sig_pool()
     # the block is unknown, so the signature stays pending for later
-    assert len(h.sig_pool) == 1
+    # (in the per-index backlog: future-block signatures cost nothing
+    # per pass until their block exists)
+    assert h.pending_signatures() == 1
+    assert len(h._sig_backlog.get(1, [])) == 1
     assert len(h.store.get_block(0).signatures) == 3
 
     # --- signature from a non-participant validator: ignored ------------
