@@ -864,10 +864,12 @@ class Hashgraph:
             self._sig_wait_commit.discard(idx)
             retained: List[BlockSignature] = []
             failed_on_empty = False
+            truncated = False
             updated = False
             for pos, bs in enumerate(bucket):
                 if verified >= self.SIG_POOL_VERIFY_BUDGET:
                     retained.extend(bucket[pos:])
+                    truncated = True
                     break
                 verified += 1
                 if not block.verify(bs):
@@ -896,7 +898,12 @@ class Hashgraph:
                     self.anchor_block = block.index()
             if retained:
                 self._sig_backlog[idx] = retained
-                if failed_on_empty:
+                # arm the skip only when EVERY retained signature actually
+                # failed against the empty body — budget-truncated ones
+                # were never verified, and for a stateless app (hash stays
+                # b"" forever) the skip would deny them a first pass for
+                # good (code review r5)
+                if failed_on_empty and not truncated:
                     self._sig_wait_commit.add(idx)
 
     def run_consensus(self) -> None:

@@ -515,13 +515,14 @@ class Node(NodeStateMachine):
             diff = self.core.event_diff(known_events)
             exported = self.core.seq
         wire_events = self.core.to_wire(diff)
+        # note the export BEFORE the send: a push whose response is lost
+        # may still have been delivered and inserted, so the bound must
+        # cover the attempt, not just confirmed successes (code review
+        # r5) — over-counting only refuses rewinds, never licenses one
+        self._note_export(exported)
         self.trans.eager_sync(
             peer_addr, EagerSyncRequest(from_id=self.id, events=wire_events)
         )
-        # the push left the node: our chain up to `exported` is now
-        # (conservatively) on the wire — evidence bound for the rewind
-        # license in fast_forward
-        self._note_export(exported)
 
     def fast_forward(self) -> None:
         """Catch-up via a peer's anchor block + frame + app snapshot

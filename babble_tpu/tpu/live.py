@@ -89,6 +89,10 @@ class LiveDeviceEngine:
         self.batch_cap = d["batch_cap"] if batch_cap is None else batch_cap
         self.upd_cap = d["upd_cap"] if upd_cap is None else upd_cap
         self.e_win = min(d["e_win"] if e_win is None else e_win, self.e_cap)
+        # single source of truth for the device round window: the span
+        # guard in _install_state and every step() call must agree, or
+        # clamped rounds slip past the guard (code review r5)
+        self.r_win = min(64, self.r_cap)
         self.round_base = 0
         self.rebases = 0
         # latency accounting (surfaced via /stats): device dispatches,
@@ -168,7 +172,7 @@ class LiveDeviceEngine:
         for b in batches_from_grid(grid, self.batch_cap, self.upd_cap, self.e_cap):
             self.state = step(
                 self.state, b, self.hg.super_majority, self.n,
-                e_win=self.e_win, r_win=min(32, self.r_cap),
+                e_win=self.e_win, r_win=self.r_win,
             )
 
     def _attach_base_round(self):
@@ -334,6 +338,25 @@ class LiveDeviceEngine:
                     )
             except StoreErr:
                 continue
+        # ROUND-SPAN GUARD: rounds are staged base-relative on a finite
+        # round axis; a kept event whose known round falls outside it
+        # would be CLAMPED, and every child computed from the clamped
+        # value comes out a few rounds low — the write-back gate then
+        # rejects the whole batch ("round write-back violates parent
+        # bounds: 9783 vs parents<= 9785", round-5 strict-loop capture),
+        # so the attach churns demote/retry forever while stamping
+        # nothing. Refuse up front instead: the host keeps deciding fame,
+        # the span shrinks, and a later attach fits.
+        r_win = self.r_win
+        max_known = max(
+            (ev.round for _, ev in kept if ev.round is not None),
+            default=base,
+        )
+        if max_known - base >= r_win - 2:  # margin for rounds formed mid-flight
+            raise GridUnsupported(
+                f"attach: round span {max_known - base} exceeds the device "
+                f"round window {r_win}"
+            )
         if len(kept) > e_cap - 4 * self.batch_cap:
             raise GridUnsupported(
                 f"rebase keeps {len(kept)} rows; capacity {e_cap} too small"
@@ -477,7 +500,7 @@ class LiveDeviceEngine:
             for b in built:
                 self.state = step(
                     self.state, b, self.hg.super_majority, self.n,
-                    e_win=self.e_win, r_win=min(32, self.r_cap),
+                    e_win=self.e_win, r_win=self.r_win,
                 )
                 self.dispatches += 1
         else:
@@ -487,7 +510,7 @@ class LiveDeviceEngine:
                 group = group + [self._empty_batch()] * (k - len(group))
                 self.state = multi_step(
                     self.state, stack_batches(group),
-                    self.hg.super_majority, self.n, e_win=self.e_win, r_win=min(32, self.r_cap),
+                    self.hg.super_majority, self.n, e_win=self.e_win, r_win=self.r_win,
                 )
                 self.dispatches += 1
         self.dispatch_seconds += _time.perf_counter() - t0
