@@ -90,6 +90,34 @@ def build_parser() -> argparse.ArgumentParser:
     kg.add_argument("--datadir", default=default_data_dir(),
                     help="Directory to write priv_key.pem into")
 
+    sim = sub.add_parser(
+        "sim",
+        help="Deterministic cluster simulation / seed sweep (docs/sim.md)",
+    )
+    sim.add_argument("--seed", type=int, default=0,
+                     help="Master seed (first seed when sweeping)")
+    sim.add_argument("--sweep", type=int, default=0, metavar="N",
+                     help="Run N consecutive seeds starting at --seed")
+    sim.add_argument("--nodes", type=int, default=4,
+                     help="Cluster size")
+    sim.add_argument("--plan", default="clean",
+                     help="Fault plan: preset name (clean, lossy, "
+                          "partition_heal, crash_restart, chaos) or a "
+                          "FaultPlan JSON file path")
+    sim.add_argument("--store", default="inmem", choices=("inmem", "sqlite"),
+                     help="Per-node store backend (sqlite survives crashes)")
+    sim.add_argument("--consensus-backend", default="cpu",
+                     choices=("cpu", "tpu"),
+                     help="Consensus engine for the simulated nodes")
+    sim.add_argument("--target-block", type=int, default=15,
+                     help="Stop once every live node commits this block")
+    sim.add_argument("--until", type=float, default=60.0,
+                     help="Virtual-time deadline in seconds")
+    sim.add_argument("--artifact-dir", default="docs/artifacts",
+                     help="Where divergence replay artifacts are written")
+    sim.add_argument("--log", default="error", choices=sorted(LOG_LEVELS),
+                     help="Log level for the simulated nodes")
+
     sub.add_parser("version", help="Show version info")
     return p
 
@@ -193,6 +221,62 @@ def run_command(args: argparse.Namespace) -> int:
     return 0
 
 
+def sim_command(args: argparse.Namespace) -> int:
+    """Deterministic simulation driver. Single-seed mode prints the run
+    result plus its block digest (the replay fingerprint: two invocations
+    with the same seed and plan must print the same digest). Sweep mode
+    runs N consecutive seeds and exits nonzero if any seed diverged —
+    each failure leaves a replay artifact under --artifact-dir."""
+    logging.basicConfig(
+        level=LOG_LEVELS[args.log],
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from .sim import FaultPlan, run_one, run_sweep
+
+    if os.path.exists(args.plan):
+        with open(args.plan) as f:
+            plan = FaultPlan.from_json(f.read())
+    else:
+        plan = args.plan  # preset name; run_one/run_sweep resolve it
+
+    common = dict(
+        plan=plan,
+        n=args.nodes,
+        store=args.store,
+        backend=args.consensus_backend,
+        until=args.until,
+        target_block=args.target_block,
+        artifact_dir=args.artifact_dir,
+    )
+    if args.sweep > 0:
+        def progress(row):
+            status = "ok" if row["ok"] else f"DIVERGED ({row['artifact']})"
+            print(
+                f"seed {row['seed']:>6}: {status}  "
+                f"blocks={row['blocks_checked']} t={row['virtual_time']}"
+                f" restarts={row['restarts']} flips={row['catchup_flips']}"
+            )
+
+        summary = run_sweep(
+            range(args.seed, args.seed + args.sweep),
+            progress=progress, **common,
+        )
+        print(
+            f"\n{summary['seeds']} seeds, {summary['failed']} failed, "
+            f"{summary['total_blocks_checked']} blocks byte-checked"
+        )
+        if summary["failed"]:
+            print(f"failing seeds: {summary['failed_seeds']}")
+            print(f"replay artifacts: {summary['artifacts']}")
+            return 1
+        return 0
+
+    res = run_one(args.seed, **common)
+    out = {k: v for k, v in res.items() if k != "rows"}
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0 if res["ok"] else 1
+
+
 def keygen_command(args: argparse.Namespace) -> int:
     try:
         key = keygen(args.datadir)
@@ -211,6 +295,8 @@ def main(argv=None) -> int:
     if args.command == "run":
         _merge_config_file(args, argv)
         return run_command(args)
+    if args.command == "sim":
+        return sim_command(args)
     if args.command == "keygen":
         return keygen_command(args)
     if args.command == "version":

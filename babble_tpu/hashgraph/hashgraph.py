@@ -540,6 +540,8 @@ class Hashgraph:
                         raise
                     round_info = RoundInfo()
 
+                is_witness = self.witness(hash_)
+
                 # lower bound prevents reprocessing the base layer after Reset
                 if not round_info.queued and (
                     self.last_consensus_round is None
@@ -547,8 +549,35 @@ class Hashgraph:
                 ):
                     self.pending_rounds.append(PendingRound(round_number, False))
                     round_info.queued = True
+                elif (
+                    is_witness
+                    and round_info.queued
+                    and not round_info.is_decided(hash_)
+                    # rounds at or below a fast-sync cut are the donor's to
+                    # decide — their votes are not derivable from the
+                    # scrubbed DAG, so re-queueing could never resolve
+                    and (
+                        self.reset_floor is None
+                        or round_number > self.reset_floor
+                    )
+                    and not any(
+                        p.index == round_number for p in self.pending_rounds
+                    )
+                ):
+                    # A witness arriving AFTER its round was decided and
+                    # dequeued (e.g. a crashed peer's pre-crash tail event
+                    # surfacing post-restart) would otherwise keep fame
+                    # UNDEFINED forever: decide_fame only visits pending
+                    # rounds, so witnesses_decided() flips false for good
+                    # and every reception scan crossing this round stalls —
+                    # while peers that held the event before deciding
+                    # receive those events normally (the round-5 survivor-
+                    # side reception divergence). Re-queue so fame resolves;
+                    # process_decided_rounds drops settled rounds again once
+                    # decided, so no block is ever re-minted.
+                    self.pending_rounds.append(PendingRound(round_number, False))
 
-                round_info.add_event(hash_, self.witness(hash_))
+                round_info.add_event(hash_, is_witness)
                 self.store.set_round(round_number, round_info)
 
             if ev.lamport_timestamp is None:
@@ -615,9 +644,11 @@ class Hashgraph:
             if round_info.witnesses_decided():
                 decided_rounds[round_index] = pos
 
+        # recompute (not just promote): a late witness re-opening a round
+        # must also UNSET a stale decided flag, or process_decided_rounds
+        # could settle the round around an undefined fame
         for pr in self.pending_rounds:
-            if pr.index in decided_rounds:
-                pr.decided = True
+            pr.decided = pr.index in decided_rounds
 
     def decide_round_received(self) -> None:
         """An event is received in the first round where all unique famous
@@ -693,13 +724,28 @@ class Hashgraph:
         try:
             while pos < len(pending):
                 pr = pending[pos]
+                # rounds at or below a fast-sync cut were settled by the
+                # donor; their fame is not re-derivable from the scrubbed
+                # DAG, so they may never read as decided here — drop them
+                # unconditionally (the original floor-skip behavior)
+                donor_settled = (
+                    self.reset_floor is not None
+                    and pr.index <= self.reset_floor
+                )
                 if (
                     self.last_consensus_round is not None
                     and pr.index <= self.last_consensus_round
+                    and (pr.decided or donor_settled)
                 ):
+                    # settled round back in the queue (re-queued for a late
+                    # witness, or section replay): fame is whole again (or
+                    # donor authority), drop it without re-minting a block
                     pos += 1
                     continue
                 # never process a decided round before all previous rounds
+                # are whole — including a settled round re-opened by a late
+                # witness: later frames must not freeze while an earlier
+                # round's famous set is still in question
                 if not pr.decided:
                     break
 
@@ -784,6 +830,25 @@ class Hashgraph:
     # every peer thread queued behind one process_sig_pool walk).
     SIG_POOL_VERIFY_BUDGET = 512
 
+    # Bound on how far ABOVE our block height a backlogged signature may
+    # claim to be before we refuse to hold it (ISSUE 1 satellite): without
+    # a horizon, a lagging node accumulates one bucket per future block
+    # its peers commit — unbounded memory held under core_lock forever if
+    # the node never catches up incrementally (it fast-forwards instead,
+    # and reset() clears pre-anchor buckets but future junk keyed by a
+    # byzantine peer's fictitious indices would survive every pass). Sized
+    # like a generous sync-limit horizon: signatures for blocks this far
+    # ahead cannot attach before a fast-forward rebuilds state anyway, and
+    # honest peers re-carry their signatures in events we re-receive then.
+    SIG_BACKLOG_HORIZON = 1024
+    # Hard cap on backlog buckets: even within the horizon, eviction keeps
+    # a byzantine flood bounded. Farthest-future buckets go first: the
+    # lowest indices are the next to attach (they advance the anchor),
+    # while far-future signatures are re-carried by honest peers' events
+    # after the fast-forward that reaching them requires — dropping those
+    # loses nothing durable.
+    SIG_BACKLOG_MAX_BUCKETS = 2048
+
     def pending_signatures(self) -> int:
         """Signatures waiting to attach: the arrival inbox plus the
         per-block backlog (observability + tests)."""
@@ -839,6 +904,33 @@ class Hashgraph:
             self._sig_wait_commit.discard(bs.index)
 
         last_block = self.store.last_block_index()
+        # backlog bound (see SIG_BACKLOG_HORIZON/MAX_BUCKETS): drop buckets
+        # past the horizon, then evict farthest-future buckets beyond the
+        # hard cap. Runs after routing so a single pass bounds whatever the
+        # inbox brought in.
+        horizon = last_block + self.SIG_BACKLOG_HORIZON
+        beyond = [i for i in self._sig_backlog if i > horizon]
+        for idx in beyond:
+            self._sig_backlog.pop(idx)
+            self._sig_wait_commit.discard(idx)
+        if beyond:
+            self.logger.warning(
+                "sig backlog: dropped %d bucket(s) beyond horizon "
+                "(last_block=%d horizon=+%d max_index=%d)",
+                len(beyond), last_block, self.SIG_BACKLOG_HORIZON,
+                max(beyond),
+            )
+        if len(self._sig_backlog) > self.SIG_BACKLOG_MAX_BUCKETS:
+            excess = sorted(self._sig_backlog, reverse=True)[
+                : len(self._sig_backlog) - self.SIG_BACKLOG_MAX_BUCKETS
+            ]
+            for idx in excess:
+                self._sig_backlog.pop(idx)
+                self._sig_wait_commit.discard(idx)
+            self.logger.warning(
+                "sig backlog: evicted %d farthest-future bucket(s) over "
+                "the %d-bucket cap", len(excess), self.SIG_BACKLOG_MAX_BUCKETS,
+            )
         verified = 0
         for idx in sorted(i for i in self._sig_backlog if i <= last_block):
             if verified >= self.SIG_POOL_VERIFY_BUDGET:

@@ -6,7 +6,11 @@ Durations are seconds (floats), not Go time.Durations.
 from __future__ import annotations
 
 import logging
+import random
 from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common import Clock, SYSTEM_CLOCK
 
 
 def _default_logger() -> logging.Logger:
@@ -31,6 +35,16 @@ class Config:
     # ppermute ring shifts, events/chains-sharded tables); any state it
     # cannot express falls down the same ladder as the single-device path
     mesh_devices: int = 0
+    # time-source seam: every monotonic read and sleep in the node layer
+    # goes through this Clock, so the deterministic simulator
+    # (babble_tpu/sim/) can drive nodes on virtual time. Production uses
+    # the shared SystemClock singleton.
+    clock: Clock = SYSTEM_CLOCK
+    # randomness seam for protocol choices (peer selection, heartbeat
+    # jitter). None = the module-level `random` generator (production);
+    # the simulator passes a per-node random.Random seeded from the run
+    # seed so replays reproduce every choice.
+    rng: Optional[random.Random] = None
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -2,6 +2,10 @@
 
 Fires on a base + rand(base) schedule onto `tick_ch`; the node resets it
 whenever there is something to gossip about and stops it when idle.
+
+Both nondeterminism sources are seams: the interval RNG and the time
+source (a `Clock`, see babble_tpu/common/clock.py) are injectable so the
+deterministic simulator can reproduce tick schedules from a seed.
 """
 
 from __future__ import annotations
@@ -11,10 +15,17 @@ import random
 import threading
 from typing import Callable, Optional
 
+from ..common import Clock, SYSTEM_CLOCK
+
 
 class ControlTimer:
-    def __init__(self, timer_factory: Callable[[], Optional[float]]):
+    def __init__(
+        self,
+        timer_factory: Callable[[], Optional[float]],
+        clock: Optional[Clock] = None,
+    ):
         self.timer_factory = timer_factory
+        self.clock = clock or SYSTEM_CLOCK
         self.tick_ch: "queue.Queue[None]" = queue.Queue(maxsize=1)
         self.set = False
         self._cv = threading.Condition()
@@ -31,20 +42,16 @@ class ControlTimer:
 
     def _arm(self) -> Optional[float]:
         self.set = True
-        import time
-
         interval = self.timer_factory()
-        return None if interval is None else time.monotonic() + interval
+        return None if interval is None else self.clock.monotonic() + interval
 
     def _loop(self) -> None:
-        import time
-
         deadline = self._arm()
         while True:
             with self._cv:
                 wait = None
                 if deadline is not None:
-                    wait = max(0.0, deadline - time.monotonic())
+                    wait = max(0.0, deadline - self.clock.monotonic())
                 self._cv.wait(timeout=min(wait, 0.05) if wait is not None else 0.05)
                 if self._shutdown:
                     self.set = False
@@ -58,7 +65,7 @@ class ControlTimer:
                     deadline = None
                     self.set = False
                     continue
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self.clock.monotonic() >= deadline:
                 # blocking hand-off like Go's unbuffered channel send, but
                 # interruptible by shutdown
                 while True:
@@ -91,10 +98,16 @@ class ControlTimer:
             thread.join(timeout=2.0)
 
 
-def new_random_control_timer(base: float) -> ControlTimer:
+def new_random_control_timer(
+    base: float,
+    rng: Optional[random.Random] = None,
+    clock: Optional[Clock] = None,
+) -> ControlTimer:
+    _rng = rng or random
+
     def random_timeout() -> Optional[float]:
         if base <= 0:
             return None
-        return base + random.uniform(0, base)
+        return base + _rng.uniform(0, base)
 
-    return ControlTimer(random_timeout)
+    return ControlTimer(random_timeout, clock=clock)

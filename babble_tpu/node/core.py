@@ -190,6 +190,29 @@ class Core:
                 try:
                     self.hg.store.get_event(ev.hex())
                 except Exception:  # noqa: BLE001 — not here: gap or fork
+                    # A skipped insert whose body is ABSENT from the store
+                    # is either a diff computed against newer state (benign
+                    # gap — the resend heals it) or a byzantine fork: a
+                    # DIFFERENT body already occupies this creator+index
+                    # slot. The creator's known high-water distinguishes
+                    # them, and the fork case must be observable — this
+                    # warning is the only trace a forking creator leaves on
+                    # an honest node's logs (the event never enters the
+                    # store).
+                    peer = self.participants.by_pub_key.get(ev.creator())
+                    slot_taken = (
+                        peer is not None
+                        and self.known_events().get(peer.id, -1) >= ev.index()
+                    )
+                    log = self.logger.warning if slot_taken else self.logger.debug
+                    log(
+                        "sync: dropped insert absent from store "
+                        "(creator=%s index=%d): %s",
+                        ev.creator()[:16], ev.index(),
+                        "byzantine fork evidence — a different body holds "
+                        "this slot" if slot_taken
+                        else "parent gap; awaiting resend",
+                    )
                     continue
                 # already present: overlapping delivery, still batch head
             other_head = ev.hex()

@@ -21,7 +21,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from typing import Dict, Optional, Tuple
 
 from ..hashgraph import Block, Store, WireEvent
@@ -75,6 +74,10 @@ class Node(NodeStateMachine):
         self.conf = conf
         self.id = id_
         self.logger = logging.LoggerAdapter(conf.logger, {"this_id": id_})
+        # every monotonic read / sleep goes through the clock seam so the
+        # deterministic simulator (babble_tpu/sim/) can run nodes on
+        # virtual time; production configs carry the SystemClock singleton
+        self.clock = conf.clock
         self.local_addr = trans.local_addr()
 
         pmap = store.participants()
@@ -95,15 +98,19 @@ class Node(NodeStateMachine):
         )
         self.core_lock = threading.Lock()
         self.selector_lock = threading.Lock()
-        self.peer_selector = RandomPeerSelector(participants, self.local_addr)
+        self.peer_selector = RandomPeerSelector(
+            participants, self.local_addr, rng=conf.rng
+        )
         self.trans = trans
         self.net_ch = trans.consumer()
         self.proxy = proxy
         self.submit_ch = proxy.submit_ch()
         self.shutdown_event = threading.Event()
-        self.control_timer = new_random_control_timer(conf.heartbeat_timeout)
+        self.control_timer = new_random_control_timer(
+            conf.heartbeat_timeout, rng=conf.rng, clock=conf.clock
+        )
 
-        self.start_time = time.monotonic()
+        self.start_time = self.clock.monotonic()
         self.sync_requests = 0
         self.sync_errors = 0
         # CatchingUp->Babbling bounces from the fast-forward rewind guards:
@@ -169,7 +176,7 @@ class Node(NodeStateMachine):
         self._run_thread.start()
 
     def run(self, gossip: bool) -> None:
-        self.start_time = time.monotonic()
+        self.start_time = self.clock.monotonic()
         self.control_timer.run()
 
         # One worker per source instead of a merged queue behind a single
@@ -400,6 +407,15 @@ class Node(NodeStateMachine):
                         "(%s)", se, exc_info=True,
                     )
                     section = None
+                # the exported bound must be read under the SAME lock that
+                # built the section (mirroring the sync-diff path at
+                # _process_sync_request): reading seq after the lock is
+                # released races concurrent add_self_event calls and would
+                # claim export of own events the section does not carry —
+                # an over-claimed bound refuses legitimate rewinds, which
+                # is exactly the frozen-frame bounce loop the license
+                # exists to break
+                exported = self.core.seq
             resp.block = block
             resp.frame = frame
             resp.section = section
@@ -407,7 +423,7 @@ class Node(NodeStateMachine):
             # serving a section exports our chain (its events include
             # ours): evidence bound for the rewind license
             if section is not None:
-                self._note_export(self.core.seq)
+                self._note_export(exported)
         except Exception as e:
             # full traceback: a donor that cannot serve (missing rounds,
             # evicted events, stale anchors) starves every joiner — the
@@ -446,46 +462,60 @@ class Node(NodeStateMachine):
                 return
             self._push(peer_addr, other_known)
         except Exception as e:
-            self.sync_errors += 1
-            level = (
-                self.logger.debug if _is_benign_race(e) else self.logger.error
-            )
-            level("gossip(%s): %s", peer_addr, e)
-            # EVICTION LIVELOCK ESCAPE (round 5): a node whose undetermined
-            # backlog outgrew the store's LRU has evicted event BODIES its
-            # peers' diffs still reference as parents — but known_events()
-            # (the rolling high-water mark) still claims those events, so
-            # peers never resend them and over_sync_limit never trips.
-            # Every sync then fails with the same KEY_NOT_FOUND forever
-            # (observed: a survivor wedged at block 274 while peers ran to
-            # 570). A store that can no longer support incremental sync
-            # has exactly one recovery: fast-forward, which rebuilds it
-            # compactly from an anchor. Three consecutive missing-parent
-            # failures distinguish the livelock from a transient race.
-            if _is_missing_parent(e):
-                self._missing_parent_syncs += 1
-                if self._missing_parent_syncs >= self._missing_parent_threshold:
-                    self.logger.warning(
-                        "sync livelocked on missing events (%s); "
-                        "flipping to CatchingUp to rebuild the store", e,
-                    )
-                    self._missing_parent_syncs = 0
-                    # escape attempts back off: when fast-forward cannot
-                    # help yet (e.g. no anchor above our height), constant
-                    # flipping would itself stall the cluster — the pinned
-                    # store makes this path rare, the backoff makes it calm
-                    self._missing_parent_threshold = min(
-                        self._missing_parent_threshold * 2, 96
-                    )
-                    # our own store is the broken party: license the
-                    # own-chain rewind (see fast_forward) — without it the
-                    # node deadlocks between the unservable store and the
-                    # rewind guard
-                    self._rewind_ok = True
-                    self.set_state(NodeState.CATCHING_UP)
-                    return_event.set()
+            if self._gossip_fail(peer_addr, e):
+                return_event.set()
             return
+        self._gossip_ok(peer_addr)
 
+    def _gossip_fail(self, peer_addr: str, e: Exception) -> bool:
+        """Bookkeeping for a failed exchange. Returns True when the failure
+        flipped the node to CatchingUp (the caller's babble loop must
+        return). Shared by the threaded gossip path and the deterministic
+        simulator (babble_tpu/sim/), which drives exchanges as scheduled
+        events but must preserve these exact escape semantics."""
+        self.sync_errors += 1
+        level = (
+            self.logger.debug if _is_benign_race(e) else self.logger.error
+        )
+        level("gossip(%s): %s", peer_addr, e)
+        # EVICTION LIVELOCK ESCAPE (round 5): a node whose undetermined
+        # backlog outgrew the store's LRU has evicted event BODIES its
+        # peers' diffs still reference as parents — but known_events()
+        # (the rolling high-water mark) still claims those events, so
+        # peers never resend them and over_sync_limit never trips.
+        # Every sync then fails with the same KEY_NOT_FOUND forever
+        # (observed: a survivor wedged at block 274 while peers ran to
+        # 570). A store that can no longer support incremental sync
+        # has exactly one recovery: fast-forward, which rebuilds it
+        # compactly from an anchor. Three consecutive missing-parent
+        # failures distinguish the livelock from a transient race.
+        if _is_missing_parent(e):
+            self._missing_parent_syncs += 1
+            if self._missing_parent_syncs >= self._missing_parent_threshold:
+                self.logger.warning(
+                    "sync livelocked on missing events (%s); "
+                    "flipping to CatchingUp to rebuild the store", e,
+                )
+                self._missing_parent_syncs = 0
+                # escape attempts back off: when fast-forward cannot
+                # help yet (e.g. no anchor above our height), constant
+                # flipping would itself stall the cluster — the pinned
+                # store makes this path rare, the backoff makes it calm
+                self._missing_parent_threshold = min(
+                    self._missing_parent_threshold * 2, 96
+                )
+                # our own store is the broken party: license the
+                # own-chain rewind (see fast_forward) — without it the
+                # node deadlocks between the unservable store and the
+                # rewind guard
+                self._rewind_ok = True
+                self.set_state(NodeState.CATCHING_UP)
+                return True
+        return False
+
+    def _gossip_ok(self, peer_addr: str) -> None:
+        """Bookkeeping for a completed exchange (also called by the
+        simulator's event-driven exchange)."""
         self._missing_parent_syncs = 0
         self._missing_parent_threshold = 3
         self._rewind_ok = False  # a full exchange worked: store is servable
@@ -642,7 +672,7 @@ class Node(NodeStateMachine):
                     self._app_committed_index = anchor_index
         except Exception as e:
             self.logger.error("fast_forward: %s", e)
-            time.sleep(self.conf.heartbeat_timeout)
+            self.clock.sleep(self.conf.heartbeat_timeout)
             return
 
         self._rewind_ok = False  # the reset rebuilt the store
@@ -735,7 +765,7 @@ class Node(NodeStateMachine):
         log("%s (consecutive bounces: %d)", msg, self._consecutive_bounces)
 
     def get_stats(self) -> Dict[str, str]:
-        elapsed = time.monotonic() - self.start_time
+        elapsed = self.clock.monotonic() - self.start_time
         consensus_events = self.core.get_consensus_events_count()
         events_per_second = consensus_events / elapsed if elapsed > 0 else 0.0
         last_consensus_round = self.core.get_last_consensus_round_index()

@@ -20,12 +20,17 @@ class PeerSelector(ABC):
 
 
 class RandomPeerSelector(PeerSelector):
-    """Uniform random choice excluding self and the last-contacted peer."""
+    """Uniform random choice excluding self and the last-contacted peer.
 
-    def __init__(self, participants: Peers, local_addr: str):
+    The RNG is injectable (defaults to the module-level `random`): the
+    deterministic simulator passes a per-node seeded random.Random so a
+    replayed seed reproduces the whole partner sequence."""
+
+    def __init__(self, participants: Peers, local_addr: str, rng=None):
         self._peers = participants
         self.local_addr = local_addr
         self.last = ""
+        self._rng = rng or random
 
     def peers(self) -> Peers:
         return self._peers
@@ -39,4 +44,4 @@ class RandomPeerSelector(PeerSelector):
             _, selectable = exclude_peer(selectable, self.local_addr)
             if len(selectable) > 1:
                 _, selectable = exclude_peer(selectable, self.last)
-        return random.choice(selectable)
+        return self._rng.choice(selectable)

@@ -1,0 +1,41 @@
+"""Deterministic cluster simulation & fault injection (ISSUE 1 tentpole).
+
+Runs full Node/Core/Hashgraph stacks on *virtual time* with every source
+of nondeterminism seeded: a `SimScheduler` event loop replaces threads, a
+`SimClock` replaces the OS clock (via the Clock seam in node configs), a
+`SimTransport` replaces the network with delivery order, latency, drops,
+partitions, duplication and crash/restart drawn from a single seeded RNG
+through a declarative `FaultPlan`. A `DivergenceChecker` byte-compares
+committed blocks across all nodes continuously; any mismatch dumps a
+replay artifact (seed + fault plan + event trace) so every heisenbug
+becomes a replayable regression test.
+
+Entry points: `SimCluster` (library), `run_one`/`run_sweep` (sweep
+harness), `python -m babble_tpu sim` (CLI). See docs/sim.md.
+"""
+
+from .clock import SimClock
+from .scheduler import SimScheduler
+from .faults import CrashSpec, FaultPlan, LatencySpec, Partition, preset_plan
+from .transport import SimNetwork, SimTransport
+from .checker import DivergenceChecker, DivergenceError
+from .cluster import SimCluster, SimNode
+from .sweep import run_one, run_sweep
+
+__all__ = [
+    "SimClock",
+    "SimScheduler",
+    "LatencySpec",
+    "Partition",
+    "CrashSpec",
+    "FaultPlan",
+    "preset_plan",
+    "SimNetwork",
+    "SimTransport",
+    "DivergenceChecker",
+    "DivergenceError",
+    "SimCluster",
+    "SimNode",
+    "run_one",
+    "run_sweep",
+]

@@ -1,0 +1,38 @@
+"""Virtual time for the deterministic simulator.
+
+`SimClock` satisfies the node layer's `Clock` seam
+(babble_tpu/common/clock.py) with scheduler-advanced time: `monotonic()`
+reads the event loop's current instant, and `sleep()` — which a real
+thread would block on — records the requested duration instead. The
+simulation is single-threaded, so a blocking sleep would freeze the
+whole world; the driver (SimCluster) collects the pending amount and
+charges it to the caller's next scheduled step, preserving the timing
+semantics the code asked for without stopping anyone else.
+"""
+
+from __future__ import annotations
+
+from ..common import Clock
+
+
+class SimClock(Clock):
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+        self._pending_sleep = 0.0
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self._pending_sleep += max(0.0, float(seconds))
+
+    def take_pending_sleep(self) -> float:
+        """Drain sleep requested since the last take — the driver adds it
+        to the requester's next wakeup delay."""
+        pending, self._pending_sleep = self._pending_sleep, 0.0
+        return pending
+
+    def advance_to(self, t: float) -> None:
+        """Monotonic advance only: the scheduler owns time's arrow."""
+        if t > self.now:
+            self.now = t

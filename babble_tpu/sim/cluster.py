@@ -1,0 +1,539 @@
+"""SimCluster: N real nodes on virtual time, choreographed as events.
+
+The simulator runs the production `Node`/`Core`/`Hashgraph` stack — same
+locks, same RPC handlers, same state machine — but never starts a single
+thread. Instead of `run_async` (control timer + worker threads + gossip
+threads), the cluster schedules one *tick* event per node and performs
+the work those threads would do, in a deterministic order:
+
+- inbound RPCs are handed straight to `Node._process_rpc` (which always
+  responds synchronously) by the network's delivery events;
+- the gossip exchange is a split-step state machine (capture known →
+  pull RPC → insert+push build → eager RPC), with virtual latency
+  between the steps — so the stale-head/overlapping-diff interleavings
+  that threads produce by accident are produced here on purpose, and
+  reproduce from the seed;
+- failure/success bookkeeping reuses `Node._gossip_fail`/`_gossip_ok`,
+  so the eviction-livelock escape, missing-parent counting and rewind
+  licensing behave byte-for-byte like the threaded path;
+- `Node.fast_forward()` runs inline through `SimTransport`'s synchronous
+  call path; its `clock.sleep` lands in the SimClock's pending-sleep
+  accumulator and is charged to the node's next tick;
+- the commit channel (normally drained by a worker thread) is drained
+  after every step that can produce blocks.
+
+Every source of nondeterminism is a stream derived from ONE master seed:
+node identities (`crypto.derive_key`), per-node protocol RNGs (peer
+selection), network faults, and transaction injection. Same seed + same
+plan => identical event sequence => identical committed blocks.
+
+Crash/restart: a crash bumps the node's generation counter (orphaning
+every scheduled callback that captured the old generation) and marks it
+dead on the network. A restart re-creates the Node — a sqlite store is
+reopened and bootstrap-replayed (the app state is rebuilt by re-committing
+the replayed blocks), an inmem store comes back empty and the node
+rejoins via fast-forward.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+from hashlib import sha256
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..crypto import derive_key, pub_key_bytes
+from ..hashgraph import InmemStore
+from ..hashgraph.sqlite_store import SQLiteStore
+from ..net import SyncRequest, EagerSyncRequest
+from ..net.transport import TransportError
+from ..node import Config, Node
+from ..node.state import NodeState
+from ..peers import Peer, Peers
+from ..proxy import InmemDummyClient
+from .checker import DivergenceChecker
+from .clock import SimClock
+from .faults import FaultPlan
+from .scheduler import SimScheduler
+from .transport import SimNetwork, SimTransport
+
+TRACE_CAP = 20_000
+
+
+class SimNode:
+    """Cluster-side handle for one simulated validator."""
+
+    def __init__(self, index: int, addr: str, key, rng: random.Random):
+        self.index = index
+        self.addr = addr
+        self.key = key
+        self.rng = rng
+        self.node: Optional[Node] = None
+        self.proxy: Optional[InmemDummyClient] = None
+        self.store_path: Optional[str] = None
+        self.crashed = False
+        # bumped on every crash AND restart: scheduled callbacks capture
+        # the generation they were created under and no-op if it moved —
+        # the simulator's version of "that thread died with the process"
+        self.gen = 0
+        self.exchange_inflight = False
+        # stats
+        self.restarts = 0
+        self.catchup_flips = 0
+        self.ff_attempts = 0
+
+    @property
+    def name(self) -> str:
+        return f"node{self.index}"
+
+
+class SimCluster:
+    def __init__(
+        self,
+        n: int = 4,
+        seed: int = 0,
+        plan: Optional[FaultPlan] = None,
+        store: str = "inmem",
+        backend: str = "cpu",
+        heartbeat: float = 0.05,
+        tcp_timeout: float = 1.0,
+        sync_limit: int = 300,
+        cache_size: int = 2000,
+        store_dir: Optional[str] = None,
+        artifact_dir: str = "docs/artifacts",
+        inject_interval: float = 0.05,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if store not in ("inmem", "sqlite"):
+            raise ValueError("store must be 'inmem' or 'sqlite'")
+        if store == "sqlite" and not store_dir:
+            raise ValueError("sqlite store needs store_dir")
+        self.n = n
+        self.seed = seed
+        self.plan = plan or FaultPlan()
+        self.store_kind = store
+        self.backend = backend
+        self.heartbeat = heartbeat
+        self.tcp_timeout = tcp_timeout
+        self.sync_limit = sync_limit
+        self.cache_size = cache_size
+        self.store_dir = store_dir
+        self.logger = logger or logging.getLogger("babble.sim")
+        self.inject_interval = inject_interval
+
+        self.clock = SimClock()
+        self.sched = SimScheduler(self.clock)
+        # purpose-split RNG streams off the master seed: string seeding is
+        # hashed (not `hash()`-randomized), so streams are stable across
+        # processes and mutually independent — consuming from one never
+        # shifts another, which keeps fault sequences stable when e.g. the
+        # tx workload changes
+        self.net_rng = random.Random(f"{seed}|net")
+        self.tx_rng = random.Random(f"{seed}|tx")
+        self.net = SimNetwork(self.sched, self.plan, self.net_rng, tcp_timeout)
+        self.checker = DivergenceChecker(artifact_dir)
+        self.trace: List[str] = []
+        self.tx_counter = 0
+        self.target_block: Optional[int] = None
+        self._injecting = False
+
+        # -- boot: identities, peers, nodes -----------------------------
+        self.sns: List[SimNode] = []
+        keys = []
+        for i in range(n):
+            secret = int.from_bytes(
+                sha256(f"{seed}|key|{i}".encode()).digest(), "big"
+            )
+            keys.append(derive_key(secret))
+        self.participants = Peers()
+        peer_of = []
+        for i, key in enumerate(keys):
+            pub_hex = "0x" + pub_key_bytes(key).hex().upper()
+            peer = Peer(net_addr=f"sim-{i}", pub_key_hex=pub_hex)
+            self.participants.add_peer(peer)
+            peer_of.append(peer)
+        for i, key in enumerate(keys):
+            sn = SimNode(i, peer_of[i].net_addr, key, random.Random(f"{seed}|node|{i}"))
+            if store == "sqlite":
+                sn.store_path = f"{store_dir}/node{i}.db"
+            self.sns.append(sn)
+            self.net.register(i, sn.addr, self._make_handler(sn))
+        for sn, peer in zip(self.sns, peer_of):
+            self._boot_node(sn, peer.id, existing_db=False)
+
+    # ------------------------------------------------------------------
+    # node lifecycle
+    # ------------------------------------------------------------------
+
+    def _boot_node(self, sn: SimNode, node_id: int, existing_db: bool) -> None:
+        conf = Config(
+            heartbeat_timeout=self.heartbeat,
+            tcp_timeout=self.tcp_timeout,
+            cache_size=self.cache_size,
+            sync_limit=self.sync_limit,
+            consensus_backend=self.backend,
+            clock=self.clock,
+            rng=sn.rng,
+            logger=self.logger,
+        )
+        if self.store_kind == "sqlite":
+            node_store = SQLiteStore(
+                self.participants, self.cache_size, sn.store_path,
+                existing_db=existing_db,
+            )
+        else:
+            node_store = InmemStore(self.participants, self.cache_size)
+        trans = SimTransport(self.net, sn.addr)
+        proxy = InmemDummyClient(self.logger)
+        node = Node(
+            conf, node_id, sn.key, self.participants, node_store, trans, proxy
+        )
+        node.init()
+        sn.node = node
+        sn.proxy = proxy
+        sn.exchange_inflight = False
+        # bootstrap replay (sqlite restart) re-emits every committed block
+        # through the commit channel: drain it now so the app state is
+        # rebuilt before the node talks to anyone
+        self._drain(sn)
+
+    def _make_handler(self, sn: SimNode):
+        def handler(rpc) -> None:
+            if sn.crashed or sn.node is None:
+                rpc.respond(None, error=f"node down: {sn.addr}")
+                return
+            sn.node._process_rpc(rpc)
+            # handling a sync can run consensus and produce blocks
+            self._drain(sn)
+
+        return handler
+
+    def _drain(self, sn: SimNode) -> None:
+        """The work of the node's tx/block worker threads: feed submitted
+        transactions into the core, apply committed blocks to the app."""
+        node = sn.node
+        while True:
+            try:
+                tx = node.submit_ch.get_nowait()
+            except queue.Empty:
+                break
+            node._add_transaction(tx)
+        while True:
+            try:
+                block = node.commit_ch.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                node.commit(block)
+            except Exception as e:  # noqa: BLE001 — like _serve_source:
+                self.logger.error("sim commit: %s", e)  # logged, not fatal
+
+    # ------------------------------------------------------------------
+    # tick: the control-timer + babble-loop work for one node
+    # ------------------------------------------------------------------
+
+    def _schedule_tick(self, sn: SimNode, extra_delay: float = 0.0) -> None:
+        gen = sn.gen
+        # the randomized control timer fires in [base, 2*base) — same
+        # distribution new_random_control_timer draws from this node's rng
+        delay = sn.rng.uniform(self.heartbeat, 2 * self.heartbeat) + extra_delay
+        self.sched.after(delay, lambda: self._tick(sn, gen), label=f"{sn.name}:tick")
+
+    def _tick(self, sn: SimNode, gen: int) -> None:
+        if sn.gen != gen or sn.crashed:
+            return
+        node = sn.node
+        self._drain(sn)
+        state = node.get_state()
+        extra = 0.0
+        if state == NodeState.CATCHING_UP:
+            sn.ff_attempts += 1
+            self._trace(f"{sn.name} fast_forward attempt")
+            node.fast_forward()  # inline: SimTransport call path, zero
+            # virtual duration; a failure's heartbeat sleep lands in the
+            # clock's pending accumulator and is charged below
+            self._drain(sn)
+            extra = self.clock.take_pending_sleep()
+            self._trace(
+                f"{sn.name} fast_forward -> {node.get_state()}"
+            )
+        elif state == NodeState.BABBLING:
+            if not sn.exchange_inflight and node._pre_gossip():
+                peer = node.peer_selector.next()
+                self._start_exchange(sn, peer.net_addr)
+        self._schedule_tick(sn, extra)
+
+    # ------------------------------------------------------------------
+    # split-step gossip exchange (the threaded _gossip as events)
+    # ------------------------------------------------------------------
+
+    def _start_exchange(self, sn: SimNode, peer_addr: str) -> None:
+        node = sn.node
+        gen = sn.gen
+        sn.exchange_inflight = True
+        node.sync_requests += 1
+        with node.core_lock:
+            known = node.core.known_events()
+        self._trace(f"{sn.name} pull -> {peer_addr}")
+
+        def finish_fail(e: TransportError) -> None:
+            if sn.gen != gen or sn.crashed:
+                return
+            sn.exchange_inflight = False
+            if node._gossip_fail(peer_addr, e):
+                sn.catchup_flips += 1
+                self._trace(f"{sn.name} -> CatchingUp (livelock escape)")
+
+        def on_pull_ok(resp) -> None:
+            if sn.gen != gen or sn.crashed:
+                return
+            if resp.sync_limit:
+                sn.exchange_inflight = False
+                sn.catchup_flips += 1
+                self._trace(f"{sn.name} SyncLimit from {peer_addr} -> CatchingUp")
+                node.set_state(NodeState.CATCHING_UP)
+                return
+            # insert the pulled diff, then build the push — both can fail
+            # locally (stale heads, missing parents) exactly like the
+            # threaded path's try block around _pull/_push
+            try:
+                if resp.events:
+                    with node.core_lock:
+                        node.sync(resp.events)
+                self._drain(sn)
+                with node.core_lock:
+                    node.core.add_self_event("")
+                with node.core_lock:
+                    if node.core.over_sync_limit(resp.known, node.conf.sync_limit):
+                        sn.exchange_inflight = False
+                        node._gossip_ok(peer_addr)
+                        return
+                    diff = node.core.event_diff(resp.known)
+                    exported = node.core.seq
+                wire_events = node.core.to_wire(diff)
+            except Exception as e:  # noqa: BLE001 — mirrors _gossip's
+                finish_fail(e)  # catch-all around the exchange
+                return
+            # export bound BEFORE the send, same as the threaded _push: a
+            # push whose response is lost may still have been delivered
+            node._note_export(exported)
+            self.net.send(
+                sn.addr, peer_addr,
+                EagerSyncRequest(from_id=node.id, events=wire_events),
+                on_ok=on_push_ok, on_fail=finish_fail,
+                label=f"{sn.name}:push",
+            )
+
+        def on_push_ok(_resp) -> None:
+            if sn.gen != gen or sn.crashed:
+                return
+            sn.exchange_inflight = False
+            node._gossip_ok(peer_addr)
+            self._drain(sn)
+
+        self.net.send(
+            sn.addr, peer_addr,
+            SyncRequest(from_id=node.id, known=known),
+            on_ok=on_pull_ok, on_fail=finish_fail,
+            label=f"{sn.name}:pull",
+        )
+
+    # ------------------------------------------------------------------
+    # faults: crash / restart
+    # ------------------------------------------------------------------
+
+    def _crash(self, sn: SimNode) -> None:
+        if sn.crashed:
+            return
+        self._trace(f"{sn.name} CRASH at t={self.clock.now:.3f}")
+        sn.crashed = True
+        sn.gen += 1  # orphan every callback the dead process scheduled
+        sn.exchange_inflight = False
+        self.net.set_alive(sn.addr, False)
+        # close the store so a sqlite file can be reopened cleanly;
+        # NOT node.shutdown(): that joins threads we never started and
+        # a real crash doesn't run shutdown hooks anyway
+        try:
+            sn.node.core.hg.store.close()
+        except Exception:  # noqa: BLE001 — a dirty close IS the crash
+            pass
+
+    def _restart(self, sn: SimNode) -> None:
+        if not sn.crashed:
+            return
+        self._trace(f"{sn.name} RESTART at t={self.clock.now:.3f}")
+        sn.crashed = False
+        sn.gen += 1
+        sn.restarts += 1
+        node_id = sn.node.id
+        # sqlite survives the crash (existing_db => bootstrap replay);
+        # inmem comes back empty and rejoins via fast-forward
+        self._boot_node(sn, node_id, existing_db=self.store_kind == "sqlite")
+        self.net.set_alive(sn.addr, True)
+        self._schedule_tick(sn)
+
+    # ------------------------------------------------------------------
+    # workload
+    # ------------------------------------------------------------------
+
+    def _inject(self) -> None:
+        if not self._injecting:
+            return
+        # closed-loop like the integration tests' bombard_and_wait: a
+        # node with a backed-up pool gets no more traffic until consensus
+        # drains it (open-loop injection just saturates core locks)
+        for _ in range(3):
+            i = self.tx_rng.randrange(self.n)
+            sn = self.sns[i]
+            if sn.crashed:
+                continue
+            if len(sn.node.core.transaction_pool) >= 50:
+                continue
+            sn.proxy.submit_tx(b"tx %d from %d" % (self.tx_counter, i))
+            self.tx_counter += 1
+        self.sched.after(self.inject_interval, self._inject, label="inject")
+
+    # ------------------------------------------------------------------
+    # run loop
+    # ------------------------------------------------------------------
+
+    def live_views(self) -> List[Tuple[str, Any]]:
+        return [
+            (sn.name, sn.node.core.hg.store)
+            for sn in self.sns
+            if not sn.crashed
+        ]
+
+    def _context(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "plan": self.plan.to_dict(),
+            "n": self.n,
+            "store": self.store_kind,
+            "backend": self.backend,
+            "virtual_time": self.clock.now,
+            "events_run": self.sched.events_run,
+            "trace": self.trace,
+        }
+
+    def check_divergence(self) -> int:
+        """Raises DivergenceError (artifact dumped) on any mismatch."""
+        return self.checker.check(self.live_views(), self._context())
+
+    def _all_reached(self, target: int) -> bool:
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            node = sn.node
+            if node.core.get_last_block_index() < target:
+                return False
+            try:
+                if not node.get_block(target).state_hash():
+                    return False
+            except Exception:  # noqa: BLE001 — joined above the target:
+                continue  # its replayed history starts past it
+        return True
+
+    def run(
+        self,
+        until: Optional[float] = None,
+        target_block: Optional[int] = None,
+        max_events: int = 2_000_000,
+        inject: bool = True,
+        check_every: float = 0.5,
+    ) -> Dict[str, Any]:
+        """Drive the cluster on virtual time until the deadline, the
+        target block (settled on every live node), or the event budget —
+        whichever comes first. Divergence raises immediately."""
+        if until is None and target_block is None:
+            raise ValueError("need until and/or target_block")
+        self.target_block = target_block
+        for sn in self.sns:
+            self._schedule_tick(sn)
+        for crash in self.plan.crashes:
+            sn = self.sns[crash.node]
+            self.sched.at(crash.at, lambda s=sn: self._crash(s), label="crash")
+            if crash.restart_at is not None:
+                self.sched.at(
+                    crash.restart_at, lambda s=sn: self._restart(s),
+                    label="restart",
+                )
+        if inject:
+            self._injecting = True
+            self.sched.after(0.0, self._inject, label="inject")
+
+        deadline = float("inf") if until is None else until
+        next_check = 0.0
+        reached = False
+        while self.sched.events_run < max_events:
+            nt = self.sched.peek_time()
+            if nt is None or nt > deadline:
+                break
+            self.sched.step()
+            if self.clock.now >= next_check:
+                self.check_divergence()
+                next_check = self.clock.now + check_every
+                if target_block is not None and self._all_reached(target_block):
+                    reached = True
+                    break
+        self._injecting = False
+        self.check_divergence()
+        return self.result(reached)
+
+    def result(self, reached_target: bool = False) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "plan": self.plan.name,
+            "virtual_time": round(self.clock.now, 3),
+            "events_run": self.sched.events_run,
+            "reached_target": reached_target,
+            "blocks_checked": self.checker.blocks_checked,
+            "checked_upto": self.checker.checked_upto,
+            "block_indices": {
+                sn.name: (
+                    -1 if sn.crashed else sn.node.core.get_last_block_index()
+                )
+                for sn in self.sns
+            },
+            "txs_injected": self.tx_counter,
+            "restarts": sum(sn.restarts for sn in self.sns),
+            "catchup_flips": sum(sn.catchup_flips for sn in self.sns),
+            "ff_attempts": sum(sn.ff_attempts for sn in self.sns),
+            "net": dict(self.net.stats),
+            "digest": self.digest(),
+        }
+
+    def digest(self) -> str:
+        """SHA-256 over every settled block body on every live node, in
+        node order — the CLI's determinism fingerprint: two runs of the
+        same seed+plan must produce the same digest."""
+        h = sha256()
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            node = sn.node
+            h.update(sn.name.encode())
+            last = node.core.get_last_block_index()
+            for i in range(last + 1):
+                try:
+                    blk = node.get_block(i)
+                except Exception:  # noqa: BLE001 — history starts above i
+                    continue
+                if not blk.state_hash():
+                    break
+                h.update(blk.body.marshal())
+        return h.hexdigest()
+
+    def shutdown(self) -> None:
+        for sn in self.sns:
+            if not sn.crashed and sn.node is not None:
+                try:
+                    sn.node.core.hg.store.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _trace(self, msg: str) -> None:
+        self.trace.append(f"t={self.clock.now:.3f} {msg}")
+        if len(self.trace) > TRACE_CAP:
+            del self.trace[: TRACE_CAP // 2]

@@ -1,0 +1,203 @@
+"""Declarative fault plans for the simulator.
+
+A `FaultPlan` is pure data — serialisable to JSON so a failing seed can be
+replayed byte-for-byte from a divergence artifact. The plan never touches
+an RNG itself: probabilistic faults (drop/dup rates, latency jitter) are
+sampled by `SimNetwork` from the cluster's seeded streams, so the plan
+stays a stable description while the seed supplies the randomness.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class LatencySpec:
+    """Per-message delivery delay: base + uniform(0, jitter) seconds."""
+
+    base: float = 0.01
+    jitter: float = 0.02
+
+    def to_dict(self) -> dict:
+        return {"base": self.base, "jitter": self.jitter}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencySpec":
+        return cls(base=float(d.get("base", 0.01)), jitter=float(d.get("jitter", 0.02)))
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Between [start, end) virtual seconds, traffic crossing group
+    boundaries is dropped. `groups` lists node indices; nodes absent from
+    every group form an implicit extra group of their own."""
+
+    start: float
+    end: float
+    groups: Sequence[Sequence[int]]
+
+    def to_dict(self) -> dict:
+        return {
+            "start": self.start,
+            "end": self.end,
+            "groups": [list(g) for g in self.groups],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Partition":
+        return cls(
+            start=float(d["start"]),
+            end=float(d["end"]),
+            groups=tuple(tuple(int(i) for i in g) for g in d["groups"]),
+        )
+
+    def active(self, t: float) -> bool:
+        return self.start <= t < self.end
+
+    def severed(self, a: int, b: int) -> bool:
+        ga = gb = None
+        for gi, g in enumerate(self.groups):
+            if a in g:
+                ga = gi
+            if b in g:
+                gb = gi
+        # nodes outside every listed group are each their own island
+        if ga is None:
+            ga = -1 - a
+        if gb is None:
+            gb = -1 - b
+        return ga != gb
+
+
+@dataclass(frozen=True)
+class CrashSpec:
+    """Crash node `node` at virtual time `at`; restart at `restart_at`
+    (None = never). On restart a sqlite-backed node reopens its store
+    (bootstrap replay); an inmem node comes back empty and must rejoin
+    via fast-forward."""
+
+    node: int
+    at: float
+    restart_at: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {"node": self.node, "at": self.at, "restart_at": self.restart_at}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CrashSpec":
+        r = d.get("restart_at")
+        return cls(
+            node=int(d["node"]),
+            at=float(d["at"]),
+            restart_at=None if r is None else float(r),
+        )
+
+
+@dataclass
+class FaultPlan:
+    name: str = "clean"
+    latency: LatencySpec = field(default_factory=LatencySpec)
+    drop_rate: float = 0.0
+    dup_rate: float = 0.0
+    partitions: List[Partition] = field(default_factory=list)
+    crashes: List[CrashSpec] = field(default_factory=list)
+
+    def partitioned(self, a: int, b: int, t: float) -> bool:
+        return any(p.active(t) and p.severed(a, b) for p in self.partitions)
+
+    # -- JSON round trip (replay artifacts embed the plan verbatim) -----
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "latency": self.latency.to_dict(),
+            "drop_rate": self.drop_rate,
+            "dup_rate": self.dup_rate,
+            "partitions": [p.to_dict() for p in self.partitions],
+            "crashes": [c.to_dict() for c in self.crashes],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls(
+            name=str(d.get("name", "custom")),
+            latency=LatencySpec.from_dict(d.get("latency", {})),
+            drop_rate=float(d.get("drop_rate", 0.0)),
+            dup_rate=float(d.get("dup_rate", 0.0)),
+            partitions=[Partition.from_dict(p) for p in d.get("partitions", [])],
+            crashes=[CrashSpec.from_dict(c) for c in d.get("crashes", [])],
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(s))
+
+
+def preset_plan(name: str, n: int) -> FaultPlan:
+    """Named plans used by tests, the CLI, and the seed sweep. `n` is the
+    cluster size (partitions and crash targets scale with it)."""
+    if name == "clean":
+        return FaultPlan(name="clean")
+    if name == "lossy":
+        return FaultPlan(
+            name="lossy",
+            latency=LatencySpec(base=0.02, jitter=0.08),
+            drop_rate=0.10,
+            dup_rate=0.05,
+        )
+    # window times below assume the default sim pace (heartbeat 0.05s:
+    # a healthy 4-node cluster commits a block roughly every 0.25s of
+    # virtual time), so each fault opens after real progress exists and
+    # heals with enough runway to converge before typical targets
+    if name == "partition_heal":
+        # split minority off for a window mid-run, then heal
+        minority = max(1, (n - 1) // 3)
+        return FaultPlan(
+            name="partition_heal",
+            latency=LatencySpec(base=0.01, jitter=0.03),
+            partitions=[
+                Partition(
+                    start=1.0,
+                    end=4.0,
+                    groups=(
+                        tuple(range(minority)),
+                        tuple(range(minority, n)),
+                    ),
+                )
+            ],
+        )
+    if name == "crash_restart":
+        return FaultPlan(
+            name="crash_restart",
+            latency=LatencySpec(base=0.01, jitter=0.03),
+            crashes=[CrashSpec(node=n - 1, at=1.5, restart_at=5.0)],
+        )
+    if name == "chaos":
+        minority = max(1, (n - 1) // 3)
+        return FaultPlan(
+            name="chaos",
+            latency=LatencySpec(base=0.02, jitter=0.10),
+            drop_rate=0.08,
+            dup_rate=0.04,
+            partitions=[
+                Partition(
+                    start=2.0,
+                    end=5.0,
+                    groups=(
+                        tuple(range(minority)),
+                        tuple(range(minority, n)),
+                    ),
+                )
+            ],
+            crashes=[CrashSpec(node=n - 1, at=3.0, restart_at=6.5)],
+        )
+    raise ValueError(
+        "unknown fault plan preset %r (known: clean, lossy, partition_heal, "
+        "crash_restart, chaos)" % name
+    )

@@ -1,0 +1,66 @@
+"""Discrete-event scheduler driving the simulation's virtual time.
+
+A single min-heap of (time, seq, label, callback). Ties in time are
+broken by insertion sequence, so two runs that schedule the same work in
+the same order execute it in the same order — the determinism backbone
+everything else (transport, ticks, fault injection) builds on.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+from .clock import SimClock
+
+
+class SimScheduler:
+    def __init__(self, clock: Optional[SimClock] = None):
+        self.clock = clock or SimClock()
+        self._heap: List[Tuple[float, int, str, Callable[[], None]]] = []
+        self._seq = itertools.count()
+        self.events_run = 0
+
+    def at(self, t: float, fn: Callable[[], None], label: str = "") -> None:
+        """Schedule fn at absolute virtual time t (clamped to now: the
+        past is immutable)."""
+        heapq.heappush(
+            self._heap, (max(t, self.clock.now), next(self._seq), label, fn)
+        )
+
+    def after(self, delay: float, fn: Callable[[], None], label: str = "") -> None:
+        self.at(self.clock.now + max(0.0, delay), fn, label)
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+    def peek_time(self) -> Optional[float]:
+        return self._heap[0][0] if self._heap else None
+
+    def step(self) -> bool:
+        """Run the earliest event; returns False when the heap is empty.
+        The clock advances to the event's time BEFORE its callback runs,
+        so everything the callback reads or schedules sees a consistent
+        'now'."""
+        if not self._heap:
+            return False
+        t, _, _, fn = heapq.heappop(self._heap)
+        self.clock.advance_to(t)
+        self.events_run += 1
+        fn()
+        return True
+
+    def run_until(self, t: float, max_events: int = 1_000_000) -> int:
+        """Run every event due at or before virtual time t (bounded by
+        max_events as a runaway backstop). Returns events executed."""
+        ran = 0
+        while (
+            ran < max_events
+            and self._heap
+            and self._heap[0][0] <= t
+        ):
+            self.step()
+            ran += 1
+        self.clock.advance_to(t)
+        return ran

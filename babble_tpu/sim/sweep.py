@@ -1,0 +1,108 @@
+"""Seed-sweep harness: hunt for divergence across many seeded runs.
+
+`run_one` executes a single seeded simulation and reports a result row
+instead of raising — a failing seed records its replay-artifact path and
+the sweep moves on, so one bad seed doesn't hide others. `run_sweep`
+iterates a seed range and aggregates. This is the acceptance harness for
+the subsystem (ISSUE 1: 50 seeds, 4 nodes, crash-restart + partition,
+zero divergence) and the intended bug-hunting entry point thereafter:
+crank the seed count up, collect artifacts, replay the failures.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Dict, List, Optional, Union
+
+from .checker import DivergenceError
+from .cluster import SimCluster
+from .faults import FaultPlan, preset_plan
+
+
+def run_one(
+    seed: int,
+    plan: Union[str, FaultPlan] = "clean",
+    n: int = 4,
+    store: str = "inmem",
+    backend: str = "cpu",
+    until: Optional[float] = 30.0,
+    target_block: Optional[int] = None,
+    artifact_dir: str = "docs/artifacts",
+    store_dir: Optional[str] = None,
+    heartbeat: float = 0.05,
+) -> Dict[str, Any]:
+    """One seeded run. Returns the cluster's result dict plus `ok` /
+    `error` / `artifact` fields; never raises on divergence."""
+    if isinstance(plan, str):
+        plan = preset_plan(plan, n)
+    tmp = None
+    if store == "sqlite" and store_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix=f"babble-sim-{seed}-")
+        store_dir = tmp.name
+    cluster = SimCluster(
+        n=n,
+        seed=seed,
+        plan=plan,
+        store=store,
+        backend=backend,
+        store_dir=store_dir,
+        artifact_dir=artifact_dir,
+        heartbeat=heartbeat,
+    )
+    try:
+        res = cluster.run(until=until, target_block=target_block)
+        res["ok"] = True
+        res["error"] = None
+        res["artifact"] = None
+    except DivergenceError as e:
+        res = cluster.result()
+        res["ok"] = False
+        res["error"] = str(e)
+        res["artifact"] = e.artifact_path
+    finally:
+        cluster.shutdown()
+        if tmp is not None:
+            tmp.cleanup()
+    return res
+
+
+def run_sweep(
+    seeds,
+    plan: Union[str, FaultPlan] = "clean",
+    n: int = 4,
+    store: str = "inmem",
+    backend: str = "cpu",
+    until: Optional[float] = 30.0,
+    target_block: Optional[int] = None,
+    artifact_dir: str = "docs/artifacts",
+    heartbeat: float = 0.05,
+    progress=None,
+) -> Dict[str, Any]:
+    """Run every seed; aggregate. `progress` (optional callable) receives
+    each finished result row — the CLI uses it to stream one line per
+    seed."""
+    rows: List[Dict[str, Any]] = []
+    for seed in seeds:
+        row = run_one(
+            seed,
+            plan=plan,
+            n=n,
+            store=store,
+            backend=backend,
+            until=until,
+            target_block=target_block,
+            artifact_dir=artifact_dir,
+            heartbeat=heartbeat,
+        )
+        rows.append(row)
+        if progress is not None:
+            progress(row)
+    failures = [r for r in rows if not r["ok"]]
+    return {
+        "seeds": len(rows),
+        "failed": len(failures),
+        "failed_seeds": [r["seed"] for r in failures],
+        "artifacts": [r["artifact"] for r in failures if r["artifact"]],
+        "total_blocks_checked": sum(r["blocks_checked"] for r in rows),
+        "rows": rows,
+    }

@@ -1,0 +1,238 @@
+"""Simulated network: fault-injected message passing on virtual time.
+
+`SimNetwork` owns every inter-node interaction. Faults (drops, latency,
+duplication, partitions, dead peers) are sampled from one dedicated RNG
+stream *in scheduling order*, so a given seed produces the same fault
+sequence on every run. Two delivery styles are offered:
+
+- `call`: synchronous request/response with zero virtual duration, used
+  where production code blocks inline on a transport verb (the node's
+  `fast_forward()` path). Faults surface as `TransportError`, exactly
+  what the threaded code expects from a real socket.
+- `send`: the event-driven round trip used by the cluster's split-step
+  gossip choreography — request leg latency, handler execution at the
+  destination, response leg latency, then `on_ok`/`on_fail` fire as
+  scheduled events. Failures are detected after `tcp_timeout`, matching
+  how a real dialer learns about a dead or partitioned peer.
+
+`SimTransport` adapts the synchronous path onto the `Transport` ABC so an
+unmodified `Node` can be constructed against the simulated network.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..net.commands import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+)
+from ..net.transport import RPC, Transport, TransportError
+from .faults import FaultPlan
+from .scheduler import SimScheduler
+
+# handler takes an inbound RPC and must respond synchronously (the
+# cluster wires this to Node._process_rpc, which always responds)
+Handler = Callable[[RPC], None]
+
+
+class SimNetwork:
+    def __init__(
+        self,
+        sched: SimScheduler,
+        plan: FaultPlan,
+        rng: random.Random,
+        tcp_timeout: float = 1.0,
+    ):
+        self.sched = sched
+        self.plan = plan
+        self.rng = rng
+        self.tcp_timeout = tcp_timeout
+        self._handlers: Dict[str, Tuple[int, Handler]] = {}
+        self._alive: Dict[str, bool] = {}
+        self.stats = {
+            "delivered": 0,
+            "dropped": 0,
+            "severed": 0,
+            "duplicated": 0,
+            "failed_calls": 0,
+        }
+
+    # -- registry -------------------------------------------------------
+
+    def register(self, idx: int, addr: str, handler: Handler) -> None:
+        self._handlers[addr] = (idx, handler)
+        self._alive[addr] = True
+
+    def set_handler(self, addr: str, handler: Handler) -> None:
+        """Re-point an address at a fresh node instance (crash-restart)."""
+        idx, _ = self._handlers[addr]
+        self._handlers[addr] = (idx, handler)
+
+    def set_alive(self, addr: str, alive: bool) -> None:
+        self._alive[addr] = alive
+
+    def node_index(self, addr: str) -> int:
+        return self._handlers[addr][0]
+
+    # -- fault sampling (one RNG stream, sampled in scheduling order) ---
+
+    def unreachable(self, src: str, dst: str) -> Optional[str]:
+        """Returns a failure reason, or None when the link is up."""
+        if dst not in self._handlers:
+            return f"failed to connect to peer: {dst}"
+        if not self._alive.get(dst, False):
+            return f"peer down: {dst}"
+        if not self._alive.get(src, False):
+            return f"sender down: {src}"
+        t = self.sched.clock.now
+        if self.plan.partitioned(self.node_index(src), self.node_index(dst), t):
+            return f"partitioned: {src} -/- {dst}"
+        return None
+
+    def sample_latency(self) -> float:
+        lat = self.plan.latency
+        return lat.base + (self.rng.uniform(0.0, lat.jitter) if lat.jitter else 0.0)
+
+    def should_drop(self) -> bool:
+        return self.plan.drop_rate > 0 and self.rng.random() < self.plan.drop_rate
+
+    def should_dup(self) -> bool:
+        return self.plan.dup_rate > 0 and self.rng.random() < self.plan.dup_rate
+
+    # -- synchronous path (inline fast-forward) -------------------------
+
+    def call(self, src: str, dst: str, command: Any) -> Any:
+        reason = self.unreachable(src, dst)
+        if reason is None and self.should_drop():
+            reason = f"dropped: {src} -> {dst}"
+            self.stats["dropped"] += 1
+        if reason is not None:
+            self.stats["failed_calls"] += 1
+            raise TransportError(reason)
+        resp = self._dispatch(dst, command)
+        self.stats["delivered"] += 1
+        if resp.error:
+            raise TransportError(resp.error)
+        return resp.response
+
+    def _dispatch(self, dst: str, command: Any):
+        rpc = RPC(command=command)
+        _, handler = self._handlers[dst]
+        handler(rpc)
+        try:
+            return rpc.resp_queue.get_nowait()
+        except queue.Empty:
+            raise TransportError(
+                f"handler for {dst} did not respond synchronously"
+            ) from None
+
+    # -- event-driven path (split-step gossip) --------------------------
+
+    def send(
+        self,
+        src: str,
+        dst: str,
+        command: Any,
+        on_ok: Callable[[Any], None],
+        on_fail: Callable[[TransportError], None],
+        label: str = "rpc",
+    ) -> None:
+        """Full round trip on virtual time. Fault decisions for this
+        message are sampled NOW (scheduling order == sampling order, the
+        determinism invariant); delivery and callbacks fire later as
+        scheduled events."""
+        reason = self.unreachable(src, dst)
+        if reason is None and self.should_drop():
+            reason = f"dropped: {src} -> {dst}"
+            self.stats["dropped"] += 1
+        elif reason is not None and "partitioned" in reason:
+            self.stats["severed"] += 1
+        if reason is not None:
+            # a dead/partitioned/dropped request surfaces at the caller
+            # only after the dial timeout, like a real socket
+            self.sched.after(
+                self.tcp_timeout,
+                lambda: on_fail(TransportError(reason)),
+                label=f"{label}:fail",
+            )
+            return
+
+        req_lat = self.sample_latency()
+        resp_lat = self.sample_latency()
+        duplicate = self.should_dup()
+
+        def deliver() -> None:
+            # destination may have crashed (or partitioned) in flight
+            late_reason = self.unreachable(src, dst)
+            if late_reason is not None:
+                self.stats["severed"] += 1
+                self.sched.after(
+                    max(0.0, self.tcp_timeout - req_lat),
+                    lambda: on_fail(TransportError(late_reason)),
+                    label=f"{label}:fail-late",
+                )
+                return
+            resp = self._dispatch(dst, command)
+            self.stats["delivered"] += 1
+            if resp.error:
+                self.sched.after(
+                    resp_lat,
+                    lambda: on_fail(TransportError(resp.error)),
+                    label=f"{label}:err",
+                )
+            else:
+                self.sched.after(
+                    resp_lat, lambda: on_ok(resp.response), label=f"{label}:ok"
+                )
+
+        self.sched.after(req_lat, deliver, label=f"{label}:deliver")
+
+        if duplicate:
+            # the destination handles the request a second time; the
+            # stray response is discarded (caller already got one)
+            self.stats["duplicated"] += 1
+            dup_lat = self.sample_latency()
+
+            def deliver_dup() -> None:
+                if self.unreachable(src, dst) is None:
+                    self._dispatch(dst, command)
+
+            self.sched.after(req_lat + dup_lat, deliver_dup, label=f"{label}:dup")
+
+
+class SimTransport(Transport):
+    """Transport ABC adapter over SimNetwork's synchronous path.
+
+    The consumer queue exists to satisfy the interface; in simulation the
+    node's RPC-dispatch thread never runs — inbound RPCs are handed to
+    `Node._process_rpc` directly by the cluster."""
+
+    def __init__(self, net: SimNetwork, addr: str):
+        self.net = net
+        self._addr = addr
+        self._consumer: "queue.Queue[RPC]" = queue.Queue()
+
+    def consumer(self) -> "queue.Queue[RPC]":
+        return self._consumer
+
+    def local_addr(self) -> str:
+        return self._addr
+
+    def sync(self, target: str, req: SyncRequest) -> SyncResponse:
+        return self.net.call(self._addr, target, req)
+
+    def eager_sync(self, target: str, req: EagerSyncRequest) -> EagerSyncResponse:
+        return self.net.call(self._addr, target, req)
+
+    def fast_forward(self, target: str, req: FastForwardRequest) -> FastForwardResponse:
+        return self.net.call(self._addr, target, req)
+
+    def close(self) -> None:
+        pass

@@ -515,6 +515,19 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
             ):
                 hg.pending_rounds.append(PendingRound(rnum, False))
                 ri.queued = True
+            elif (
+                bool(res.witness[r])
+                and ri.queued
+                and not ri.is_decided(h)
+                # rounds at/below a fast-sync cut are the donor's to decide
+                and (hg.reset_floor is None or rnum > hg.reset_floor)
+                and not any(p.index == rnum for p in hg.pending_rounds)
+            ):
+                # late witness into a decided-and-dequeued round: re-queue
+                # so fame resolves, mirroring the host divide_rounds rule —
+                # otherwise the cpu engine un-freezes the round this call
+                # and a device-backend node diverges from it
+                hg.pending_rounds.append(PendingRound(rnum, False))
             ri.add_event(h, bool(res.witness[r]))
 
     # --- write-back: DecideFame (reference: hashgraph.go:852-947) ---
@@ -553,9 +566,25 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
                 ri.set_fame(grid.hashes[wrow], bool(res.famous[ti, c]))
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
+    undecided_pending = [
+        pr for pr in hg.pending_rounds if pr.index not in decided_rounds
+    ]
     for pr in hg.pending_rounds:
-        if pr.index in decided_rounds:
-            pr.decided = True
+        pr.decided = pr.index in decided_rounds
+    if undecided_pending:
+        # completeness net: a re-queued round can sit below the device
+        # table's rebased window (ti out of range above), so its late
+        # witness would never get fame from the device write-back. The
+        # host pass skips every already-decided witness, so on a healthy
+        # state this is O(pending) dict lookups; it only votes for the
+        # stragglers — and recomputes pr.decided itself.
+        for rnum, ri in round_infos.items():
+            hg.store.set_round(rnum, ri)
+        hg.decide_fame()
+        for pr in hg.pending_rounds:
+            ri = round_infos.get(pr.index)
+            if ri is not None:
+                round_infos[pr.index] = hg.store.get_round(pr.index)
 
     # --- write-back: DecideRoundReceived (reference: hashgraph.go:951-1036) ---
     rr_clean = admissible_receptions(

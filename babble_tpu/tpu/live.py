@@ -60,9 +60,16 @@ def derive_fd_updates(grid: DagGrid) -> List[List[Tuple[int, int, int]]]:
 
 
 # constructor defaults, module-level so tests can shrink the capacities
-# to force rebases quickly
+# to force rebases quickly.
+# r_win (the live-stepping round window) widened 32 -> 64 DELIBERATELY in
+# round 5: post-fast-sync recovery states exhibit round spans past 32
+# that tripped the attach span guard into attach/demote/retry churn
+# (docs/tpu.md "Round-5: attach-window guards" has the measured numbers).
+# It is a named default — not a buried constant — so the choice stays
+# visible and tests/benchmarks can narrow it explicitly.
 ENGINE_DEFAULTS = dict(
     e_cap=1 << 16, r_cap=64, batch_cap=64, upd_cap=8192, e_win=8192,
+    r_win=64,
 )
 
 
@@ -80,7 +87,7 @@ class LiveDeviceEngine:
 
     def __init__(self, hg, e_cap: int = None, r_cap: int = None,
                  batch_cap: int = None, upd_cap: int = None,
-                 e_win: int = None):
+                 e_win: int = None, r_win: int = None):
         d = ENGINE_DEFAULTS
         self.hg = hg
         self.n = len(hg.participants.to_peer_slice())
@@ -91,8 +98,9 @@ class LiveDeviceEngine:
         self.e_win = min(d["e_win"] if e_win is None else e_win, self.e_cap)
         # single source of truth for the device round window: the span
         # guard in _install_state and every step() call must agree, or
-        # clamped rounds slip past the guard (code review r5)
-        self.r_win = min(64, self.r_cap)
+        # clamped rounds slip past the guard (code review r5). The default
+        # is the deliberate 64-wide window (see ENGINE_DEFAULTS).
+        self.r_win = min(d["r_win"] if r_win is None else r_win, self.r_cap)
         self.round_base = 0
         self.rebases = 0
         # latency accounting (surfaced via /stats): device dispatches,

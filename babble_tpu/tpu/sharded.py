@@ -38,6 +38,21 @@ from .engine import PassResults
 from .frontier import frontier_post
 from .grid import DagGrid, MAX_INT32
 
+# jax.shard_map is top-level only from jax 0.5; 0.4.x ships it under
+# experimental with the same signature, but its replication checker
+# predates lax.while_loop support ("No replication rule for while"), so
+# the fallback disables the check — out_specs still define the layout
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        return _exp_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
 # module-level jit so repeated pipeline runs reuse the compiled post-walk
 _frontier_post_jit = jax.jit(frontier_post)
 
@@ -144,7 +159,7 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
     shp3 = P(axis, None, None)
     rep = P()
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local_fame,
             mesh=mesh,
             in_specs=(rep, P(axis), shp2, shp3, shp2, shp2, shp3, shp2, shp2),
@@ -169,7 +184,7 @@ def _received_fn(mesh: Mesh, axis: str):
     shp = P(axis)
     rep = P()
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local_received,
             mesh=mesh,
             in_specs=(shp, shp, shp, rep, rep, rep, rep),
@@ -320,7 +335,7 @@ def _sharded_build_inv_fn(mesh: Mesh, axis: str):
     from .frontier import build_inv
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             build_inv,
             mesh=mesh,
             in_specs=(P(axis, None), P()),
@@ -410,7 +425,7 @@ def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
         return x_hist_local  # (r_cap, B)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local_walk,
             mesh=mesh,
             in_specs=(P(axis, None, None), P(axis, None), P(), P(), P(axis)),

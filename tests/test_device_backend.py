@@ -13,6 +13,7 @@ device engine afterwards — the state VERDICT r1 flagged as fatal
 """
 
 import copy
+import pytest
 
 from babble_tpu.crypto import generate_key, pub_key_bytes
 from babble_tpu.hashgraph import InmemStore
@@ -326,6 +327,7 @@ def test_mixed_backend_fast_sync_byte_identical():
         shutdown_nodes(nodes)
 
 
+@pytest.mark.slow
 def test_live_engine_reattaches_after_fast_sync():
     """VERDICT r2 #4: demotions must heal. A device-backend node that
     fast-syncs must RETURN to the incremental live engine afterwards (via

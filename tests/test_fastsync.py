@@ -2,6 +2,7 @@
 (reference: src/node/node_test.go:455,497,533,583,660)."""
 
 import copy
+import pytest
 import os
 import time
 
@@ -220,6 +221,7 @@ def test_spurious_catching_up_bounces_back():
         shutdown_nodes(nodes)
 
 
+@pytest.mark.slow
 def test_catch_up():
     """Start 3 of 4 nodes, run ahead beyond sync-limit, then start the 4th:
     it must flip to CatchingUp, fast-forward from a peer's anchor block and

@@ -11,6 +11,7 @@
   extended with a forced second-generation donor).
 """
 
+import pytest
 import socket
 import struct
 import threading
@@ -241,6 +242,7 @@ class ForgingDummyClient(InmemDummyClient):
         return super().get_snapshot(block_index)
 
 
+@pytest.mark.slow
 def test_fast_forward_rejects_forged_snapshot():
     """While every reachable donor forges snapshots, a joiner must refuse
     to leave CatchingUp (the restored state hash cannot reproduce the
@@ -290,6 +292,7 @@ def test_fast_forward_rejects_forged_snapshot():
         shutdown_nodes(nodes)
 
 
+@pytest.mark.slow
 def test_chained_fast_sync_donor():
     """Second-generation fast-sync: node D joins via fast-forward; later
     node C rejoins with connectivity ONLY to D, so D — itself a product of

@@ -96,3 +96,33 @@ def test_insert_events_with_block_signatures():
         assert block0.verify(block0.get_signature(
             "0x" + n.pub.hex().upper()
         ))
+
+
+def test_sig_backlog_bounded():
+    """The per-block signature backlog is bounded two ways: buckets past
+    the horizon above the committed height are dropped outright, and even
+    within the horizon the farthest-future buckets are evicted beyond a
+    hard bucket cap (a byzantine peer flooding fictitious block indices
+    must not grow memory without bound). Nearest-future buckets survive —
+    they are the next to attach and advance the anchor."""
+    h, nodes, index, ordered = init_block_hashgraph()
+    # shrink the bounds so the test exercises both evictions cheaply
+    h.SIG_BACKLOG_HORIZON = 100
+    h.SIG_BACKLOG_MAX_BUCKETS = 10
+
+    future = Block(1, 2, b"framehash", [])
+    beyond_horizon = future.sign(nodes[0].key)
+    beyond_horizon.index = 500  # last_block=0, horizon=100: evicted
+    h.sig_pool.append(beyond_horizon)
+    for i in range(2, 52):  # 50 buckets inside the horizon
+        bs = future.sign(nodes[0].key)
+        bs.index = i
+        h.sig_pool.append(bs)
+
+    h.process_sig_pool()
+
+    assert 500 not in h._sig_backlog
+    assert len(h._sig_backlog) == 10
+    # eviction removed the FARTHEST-future buckets, kept the nearest
+    assert min(h._sig_backlog) == 2
+    assert max(h._sig_backlog) == 11
