@@ -4,10 +4,28 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test bench soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint bench soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# consensus-grade static analysis (babble_tpu/analysis/, docs/analysis.md):
+# determinism lint + lock-discipline checker + JAX staging audit. Hard
+# gate. ruff/mypy are an advisory second tier — they run only where
+# installed (pip install -e '.[lint]'); the container image does not
+# ship them.
+lint:
+	$(PY) -m babble_tpu lint
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check babble_tpu/; \
+	else \
+		echo "lint: ruff not installed — skipping advisory tier"; \
+	fi
+	@if command -v mypy >/dev/null 2>&1; then \
+		mypy --config-file pyproject.toml || true; \
+	else \
+		echo "lint: mypy not installed — skipping advisory tier"; \
+	fi
 
 bench:
 	$(PY) bench.py

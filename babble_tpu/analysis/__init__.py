@@ -1,0 +1,39 @@
+"""Consensus-grade static analysis (docs/analysis.md).
+
+Three AST checker families over the package source:
+
+- determinism lint (determinism.py): wall-clock/RNG/set-order/hash()
+  nondeterminism that would diverge replicas computing the same DAG;
+- lock-discipline checker (locks.py): `# guarded-by:` race detection for
+  shared attributes in the threaded node/net/proxy runtime;
+- JAX staging audit (staging.py): tracer-hostile Python inside
+  `jax.jit`-staged device kernels.
+
+Run via `babble-tpu lint` / `make lint`; the checked-in baseline
+(baseline.json) pins accepted findings so the gate stays green while
+real findings are burned down. PR 1's simulator catches divergence
+dynamically per seed; this package is the static half of the same
+correctness story.
+"""
+
+from .core import Finding, SourceFile, load_baseline, write_baseline
+from .determinism import check_determinism
+from .locks import check_locks
+from .runner import LintResult, format_report, lint_file, main, run_lint
+from .staging import check_staging, find_staged_functions
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "LintResult",
+    "check_determinism",
+    "check_locks",
+    "check_staging",
+    "find_staged_functions",
+    "format_report",
+    "lint_file",
+    "load_baseline",
+    "main",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,227 @@
+"""Shared machinery for the static-analysis framework (docs/analysis.md).
+
+A checker is a function `(SourceFile) -> Iterable[Finding]`. This module
+owns everything the checkers share: parsed source files (AST + the
+comment map the waiver syntax lives in), finding construction, waiver
+matching, and the checked-in baseline that lets the gate start green
+while real findings are burned down.
+
+Waivers are trailing comments on the flagged line (or a standalone
+comment on the line directly above it):
+
+    x = time.monotonic()        # det-ok: duration instrumentation only
+    self._pool.clear()          # unguarded-ok: shutdown is single-threaded
+    if flag: ...                # jax-ok: static python bool
+
+Each checker family has its own waiver tag (`det-ok`, `unguarded-ok`,
+`jax-ok`); `lint-ok` waives any rule. A waiver must carry a reason after
+the colon — a bare tag does not suppress, so every suppression is
+self-documenting.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# waiver tag accepted by every rule family
+GENERIC_WAIVER = "lint-ok"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a file:line."""
+
+    rule: str  # e.g. "det-wallclock"
+    path: str  # repo-relative path
+    line: int  # 1-based
+    message: str
+    symbol: str = ""  # enclosing class/function qualname, for fingerprints
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def fingerprint(self, line_text: str) -> Dict[str, str]:
+        """Line-number-independent identity used by the baseline: the rule,
+        the file, the enclosing symbol and the stripped source text. Edits
+        that move a baselined line keep it suppressed; edits that change
+        the flagged code resurface it."""
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "symbol": self.symbol,
+            "text": line_text.strip(),
+        }
+
+
+@dataclass
+class SourceFile:
+    """A parsed module: AST plus the comment/waiver map checkers consult."""
+
+    path: str  # repo-relative, forward slashes
+    text: str
+    tree: ast.Module
+    # line -> full comment text ("# ..." stripped of the leading hash)
+    comments: Dict[int, str] = field(default_factory=dict)
+    lines: List[str] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, abspath: str, relpath: str) -> "SourceFile":
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=relpath)
+        comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass  # unterminated constructs: AST parsed, comments best-effort
+        return cls(
+            path=relpath.replace(os.sep, "/"),
+            text=text,
+            tree=tree,
+            comments=comments,
+            lines=text.splitlines(),
+        )
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def comment_on_or_above(self, line: int) -> List[str]:
+        out = []
+        for ln in (line, line - 1):
+            c = self.comments.get(ln)
+            if c is not None:
+                # a comment on the line above only counts if that line is
+                # comment-only (a trailing comment there waives ITS line)
+                if ln == line or self.line_text(ln).lstrip().startswith("#"):
+                    out.append(c)
+        return out
+
+    def has_waiver(self, line: int, tag: str) -> bool:
+        """True when `# <tag>: <reason>` (or `# lint-ok: <reason>`) sits on
+        the line or on a comment-only line directly above. The reason is
+        mandatory: a tag with nothing after the colon does not waive."""
+        for c in self.comment_on_or_above(line):
+            for t in (tag, GENERIC_WAIVER):
+                if c.startswith(t):
+                    rest = c[len(t):]
+                    if rest.startswith(":") and rest[1:].strip():
+                        return True
+        return False
+
+
+class SymbolTracker(ast.NodeVisitor):
+    """Base visitor that maintains the enclosing class/function qualname so
+    findings carry a stable symbol for baseline fingerprints."""
+
+    def __init__(self) -> None:
+        self._stack: List[str] = []
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self._stack)
+
+    def _push_visit(self, node: ast.AST, name: str) -> None:
+        self._stack.append(name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:  # noqa: N802
+        self._push_visit(node, node.name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:  # noqa: N802
+        self._push_visit(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node) -> None:  # noqa: N802
+        self._push_visit(node, node.name)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module, module: str) -> Tuple[set, Dict[str, str]]:
+    """(module aliases, {local name: original name}) for `import module
+    [as X]` and `from module import name [as Y]` — checkers resolve
+    aliased call sites (`import time as _time; _time.monotonic()`)."""
+    mod_aliases = set()
+    member_aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module:
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == module:
+            for a in node.names:
+                member_aliases[a.asname or a.name] = a.name
+    return mod_aliases, member_aliases
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("findings", [])
+
+
+def write_baseline(path: str, entries: List[Dict[str, str]]) -> None:
+    payload = {
+        "comment": (
+            "Accepted pre-existing findings (babble-tpu lint). New code "
+            "must not add entries here — fix or waive with a reasoned "
+            "comment instead. Regenerate with: babble-tpu lint "
+            "--write-baseline"
+        ),
+        "findings": sorted(
+            entries, key=lambda e: (e["rule"], e["path"], e["symbol"], e["text"])
+        ),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def split_baselined(
+    findings: Iterable[Tuple[Finding, str]], baseline: List[Dict[str, str]]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Partition (finding, line_text) pairs into (new, baselined). Each
+    baseline entry suppresses at most one finding per run, so duplicating
+    a baselined pattern still fails the gate."""
+    budget: Dict[Tuple[str, str, str, str], int] = {}
+    for e in baseline:
+        key = (e["rule"], e["path"], e.get("symbol", ""), e["text"])
+        budget[key] = budget.get(key, 0) + 1
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f, line_text in findings:
+        fp = f.fingerprint(line_text)
+        key = (fp["rule"], fp["path"], fp["symbol"], fp["text"])
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

@@ -1,0 +1,219 @@
+"""Determinism lint: consensus replicas must compute the same order from
+the same DAG, so consensus-critical code may not read ambient
+nondeterminism (docs/analysis.md; the invariant catalog is
+arXiv:2102.01167 / arXiv:2210.13682).
+
+Rules (waiver tag `det-ok`):
+
+- det-wallclock  — direct `time.time` / `time.monotonic` / `time.sleep`
+  (and their `_ns` variants) calls. The node layer's only legitimate time
+  source is the injected Clock seam (common/clock.py); a bypass silently
+  unplugs the deterministic simulator's virtual time. `time.perf_counter`
+  is exempt: duration-only instrumentation that cannot express an
+  absolute schedule. Scope: the whole package (the seam is repo policy),
+  minus the seam itself and the simulator.
+- det-random     — module-level `random.*` calls (the shared, unseeded
+  generator) in consensus-critical modules. Protocol randomness must come
+  from the injected per-node `random.Random` (node/config.py `rng`).
+- det-set-order  — iteration over a value statically known to be a `set`
+  (literal, constructor, comprehension, or a local/attribute assigned
+  one) without `sorted(...)` in consensus-critical modules: set order
+  varies across processes (PYTHONHASHSEED), so any event/block ordering
+  fed from it diverges between replicas.
+- det-builtin-hash — builtin `hash()` in consensus-critical modules: it
+  is salted per-process for str/bytes. Content identity must use
+  crypto/hashing.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set
+
+from .core import Finding, SourceFile, SymbolTracker, dotted_name, import_aliases
+
+WAIVER = "det-ok"
+
+# time.<member> calls that bypass the Clock seam
+WALLCLOCK_MEMBERS = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "sleep",
+}
+
+# random-module members that read or reseed the shared global generator
+RANDOM_MEMBERS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "seed", "getrandbits", "gauss", "betavariate",
+    "expovariate", "normalvariate", "triangular", "vonmisesvariate",
+}
+
+
+def _set_typed_names(tree: ast.Module) -> Set[str]:
+    """Local/attribute names assigned a set-valued expression anywhere in
+    the module — one-level flow tracking, enough to catch the common
+    `pending = set(...)` ... `for x in pending` shape."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = node.value
+            if value is None or not _is_set_expr(value):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                name = dotted_name(t)
+                if name:
+                    names.add(name)
+    return names
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        if callee in ("set", "frozenset"):
+            return True
+        # s.union(...) / s.intersection(...) / s.difference(...) chains
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "union", "intersection", "difference", "symmetric_difference",
+            "copy",
+        ):
+            return _is_set_expr(node.func.value)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+def _iter_targets(sf: SourceFile) -> Iterator[ast.expr]:
+    """Every expression a statement iterates over: for-loops and all
+    comprehension generators."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield node.iter
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter
+
+
+class _DetVisitor(SymbolTracker):
+    def __init__(
+        self,
+        sf: SourceFile,
+        consensus_critical: bool,
+        time_mods: Set[str],
+        time_members: dict,
+        random_mods: Set[str],
+        random_members: dict,
+        set_names: Set[str],
+    ) -> None:
+        super().__init__()
+        self.sf = sf
+        self.consensus_critical = consensus_critical
+        self.time_mods = time_mods
+        self.time_members = time_members
+        self.random_mods = random_mods
+        self.random_members = random_members
+        self.set_names = set_names
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = node.lineno
+        if self.sf.has_waiver(line, WAIVER):
+            return
+        self.findings.append(
+            Finding(rule=rule, path=self.sf.path, line=line,
+                    message=message, symbol=self.symbol)
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        callee = dotted_name(node.func)
+        if callee:
+            self._check_wallclock(node, callee)
+            if self.consensus_critical:
+                self._check_random(node, callee)
+                if callee == "hash":
+                    self._emit(
+                        "det-builtin-hash", node,
+                        "builtin hash() is salted per-process "
+                        "(PYTHONHASHSEED); use crypto/hashing.py for "
+                        "content identity",
+                    )
+        self.generic_visit(node)
+
+    def _check_wallclock(self, node: ast.Call, callee: str) -> None:
+        member: Optional[str] = None
+        if "." in callee:
+            mod, attr = callee.rsplit(".", 1)
+            if mod in self.time_mods:
+                member = attr
+        elif callee in self.time_members:
+            member = self.time_members[callee]
+        if member in WALLCLOCK_MEMBERS:
+            self._emit(
+                "det-wallclock", node,
+                f"time.{member}() bypasses the Clock seam "
+                "(common/clock.py); take a Clock and use "
+                f"clock.{'sleep' if member == 'sleep' else 'monotonic'}() "
+                "so simulated virtual time governs this path",
+            )
+
+    def _check_random(self, node: ast.Call, callee: str) -> None:
+        member: Optional[str] = None
+        if "." in callee:
+            mod, attr = callee.rsplit(".", 1)
+            if mod in self.random_mods:
+                member = attr
+        elif callee in self.random_members:
+            member = self.random_members[callee]
+        if member in RANDOM_MEMBERS:
+            self._emit(
+                "det-random", node,
+                f"module-level random.{member}() uses the shared unseeded "
+                "generator; route through the injected per-node "
+                "random.Random (node/config.py rng)",
+            )
+
+
+def check_determinism(sf: SourceFile, consensus_critical: bool) -> Iterable[Finding]:
+    time_mods, time_members = import_aliases(sf.tree, "time")
+    random_mods, random_members = import_aliases(sf.tree, "random")
+    set_names = _set_typed_names(sf.tree) if consensus_critical else set()
+
+    visitor = _DetVisitor(
+        sf, consensus_critical, time_mods, time_members,
+        random_mods, random_members, set_names,
+    )
+    visitor.visit(sf.tree)
+    findings = list(visitor.findings)
+
+    if consensus_critical:
+        findings.extend(_check_set_iteration(sf, set_names))
+    return findings
+
+
+def _check_set_iteration(sf: SourceFile, set_names: Set[str]) -> Iterator[Finding]:
+    for target in _iter_targets(sf):
+        expr = target
+        if _is_set_expr(expr):
+            pass  # direct literal/constructor iteration
+        else:
+            name = dotted_name(expr)
+            if name is None or name not in set_names:
+                continue
+        # sorted(<set>) never reaches here: the iter expression is then the
+        # sorted() call, which is neither a set expr nor a tracked name
+        line = expr.lineno
+        if sf.has_waiver(line, WAIVER):
+            continue
+        yield Finding(
+            rule="det-set-order",
+            path=sf.path,
+            line=line,
+            message=(
+                "iteration over a set: element order varies per process "
+                "(PYTHONHASHSEED) and diverges replicas if it feeds "
+                "event/block ordering; wrap in sorted(...) or iterate a "
+                "deterministic container"
+            ),
+        )

@@ -1,0 +1,177 @@
+"""Lock-discipline checker: a lightweight static race detector over
+`# guarded-by:` annotations (docs/analysis.md).
+
+Annotation syntax — all are ordinary comments, so the runtime is
+untouched:
+
+- `self.attr = ...  # guarded-by: _lock` (in __init__ or a class-body
+  AnnAssign) declares that every other read/write of `self.attr` in the
+  class must happen lexically inside `with self._lock:` (any lock name
+  works, including RLocks and Conditions used as context managers).
+- `def method(...):  # requires-lock: _lock` (trailing on the `def` line
+  or a comment line directly above it) declares a method whose CALLERS
+  hold the lock — its body counts as guarded. The claim itself is not
+  verified across call sites (documented limitation); the annotation
+  makes the contract grep-able and keeps the checker sound within the
+  class body.
+- `... # unguarded-ok: <reason>` waives one access (e.g. a deliberately
+  racy monotonic counter read where staleness is safe).
+
+`__init__` is exempt: the object has not been shared yet, so
+construction-time writes happen-before every guarded access.
+
+Rule id: `lock-guarded-by`. The checker is lexical — it does not model
+aliasing (`lock = self._lock; with lock:`) or cross-object accesses
+(`other.attr`); both are rare in this codebase and read as smells anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import Finding, SourceFile
+
+WAIVER = "unguarded-ok"
+
+_GUARDED_BY = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_REQUIRES_LOCK = re.compile(r"requires-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' for a `self.attr` Name/Attribute access, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_annotations(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """{attr: lock} from `# guarded-by:` trailing comments on `self.attr`
+    assignment lines anywhere in the class (class-body AnnAssigns too)."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            comment = sf.comments.get(node.lineno)
+            if not comment:
+                continue
+            m = _GUARDED_BY.search(comment)
+            if not m:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id  # class-body declaration
+                if attr:
+                    guarded[attr] = m.group(1)
+    return guarded
+
+
+def _held_locks_for_with(item: ast.withitem) -> Optional[str]:
+    """Lock attr name for a `with self.<lock>:` context item."""
+    return _self_attr(item.context_expr)
+
+
+def _requires_lock(sf: SourceFile, fn: ast.FunctionDef) -> Set[str]:
+    """Locks declared held-on-entry for a method via `# requires-lock:`."""
+    held: Set[str] = set()
+    for c in sf.comment_on_or_above(fn.lineno):
+        m = _REQUIRES_LOCK.search(c)
+        if m:
+            held.add(m.group(1))
+    return held
+
+
+class _MethodWalker:
+    """Walk one method body tracking the set of `self.<lock>` names whose
+    `with` scope lexically encloses the current node."""
+
+    def __init__(
+        self,
+        sf: SourceFile,
+        cls_name: str,
+        fn: ast.FunctionDef,
+        guarded: Dict[str, str],
+    ) -> None:
+        self.sf = sf
+        self.cls_name = cls_name
+        self.fn = fn
+        self.guarded = guarded
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        held = _requires_lock(self.sf, self.fn)
+        for stmt in self.fn.body:
+            self._walk(stmt, held)
+        return self.findings
+
+    def _walk(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = {
+                lock for item in node.items
+                if (lock := _held_locks_for_with(item)) is not None
+            }
+            for item in node.items:
+                self._walk(item.context_expr, held)
+            inner = held | acquired
+            for stmt in node.body:
+                self._walk(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: it may run later on another thread, so locks held
+            # at the definition site are NOT held in its body — unless the
+            # nested def itself declares requires-lock
+            inner_held = _requires_lock(self.sf, node)
+            for stmt in node.body:
+                self._walk(stmt, inner_held)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, set())
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            lock = self.guarded[attr]
+            if lock not in held and not self.sf.has_waiver(node.lineno, WAIVER):
+                self.findings.append(
+                    Finding(
+                        rule="lock-guarded-by",
+                        path=self.sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"self.{attr} is guarded-by {lock} but accessed "
+                            f"outside `with self.{lock}:`; hold the lock, "
+                            "mark the method `# requires-lock: "
+                            f"{lock}`, or waive with `# unguarded-ok: "
+                            "<reason>`"
+                        ),
+                        symbol=f"{self.cls_name}.{self.fn.name}",
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+def check_locks(sf: SourceFile) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guarded = _collect_annotations(sf, node)
+        if not guarded:
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue  # happens-before: not yet shared
+            findings.extend(
+                _MethodWalker(sf, node.name, item, guarded).run()
+            )
+    return findings

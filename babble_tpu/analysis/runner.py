@@ -1,0 +1,216 @@
+"""Lint driver: discovers package sources, classifies their scope, runs
+the three checker families, applies the baseline and formats the report
+(docs/analysis.md). The CLI (`babble-tpu lint`) and `make lint` both land
+here; tests drive `run_lint` directly.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .core import (
+    Finding,
+    SourceFile,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from .determinism import check_determinism
+from .locks import check_locks
+from .staging import check_staging
+
+# modules where replica-identical computation is decided: the five-pass
+# pipeline, the device kernels that mirror it, and the consensus façade.
+# The full det rule set (random/set-order/builtin-hash) applies here;
+# det-wallclock applies package-wide (the Clock seam is repo policy).
+CONSENSUS_CRITICAL_PREFIXES = (
+    "babble_tpu/hashgraph/",
+    "babble_tpu/tpu/",
+    "babble_tpu/node/core.py",
+)
+
+# the simulator IMPLEMENTS the clock/rng seams and the seam module wraps
+# the OS clock by definition; linting them against themselves is noise
+EXCLUDED_PREFIXES = (
+    "babble_tpu/sim/",
+    "babble_tpu/analysis/",
+    "babble_tpu/common/clock.py",
+)
+
+# modules whose shared state carries guarded-by annotations
+LOCK_SCOPE_PREFIXES = (
+    "babble_tpu/node/",
+    "babble_tpu/net/",
+    "babble_tpu/service.py",
+    "babble_tpu/peers/",
+    "babble_tpu/proxy/",
+)
+
+STAGING_SCOPE_PREFIXES = ("babble_tpu/tpu/",)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _matches(path: str, prefixes: Tuple[str, ...]) -> bool:
+    return any(
+        path == p or path.startswith(p) for p in prefixes
+    )
+
+
+@dataclass
+class LintResult:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    files_checked: int = 0
+    errors: List[str] = field(default_factory=list)  # unparseable files
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.errors
+
+
+def _discover(root: str, paths: Optional[List[str]]) -> List[Tuple[str, str]]:
+    """[(abspath, relpath-from-root)] of .py files to lint. `paths` (files
+    or directories, absolute or root-relative) narrows the run; default is
+    the whole babble_tpu package under `root`."""
+    targets = paths or [os.path.join(root, "babble_tpu")]
+    out: List[Tuple[str, str]] = []
+    for t in targets:
+        t = t if os.path.isabs(t) else os.path.join(root, t)
+        if os.path.isfile(t):
+            out.append((t, os.path.relpath(t, root)))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(t):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    ap = os.path.join(dirpath, fn)
+                    out.append((ap, os.path.relpath(ap, root)))
+    return sorted(set(out))
+
+
+def lint_file(sf: SourceFile) -> List[Finding]:
+    """All checker families applicable to one parsed file, by scope."""
+    findings: List[Finding] = []
+    if _matches(sf.path, EXCLUDED_PREFIXES):
+        return findings
+    findings.extend(
+        check_determinism(
+            sf, consensus_critical=_matches(sf.path, CONSENSUS_CRITICAL_PREFIXES)
+        )
+    )
+    if _matches(sf.path, LOCK_SCOPE_PREFIXES):
+        findings.extend(check_locks(sf))
+    if _matches(sf.path, STAGING_SCOPE_PREFIXES):
+        findings.extend(check_staging(sf))
+    return findings
+
+
+def run_lint(
+    root: str,
+    paths: Optional[List[str]] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    update_baseline: bool = False,
+) -> LintResult:
+    result = LintResult()
+    pairs: List[Tuple[Finding, str]] = []
+    for abspath, relpath in _discover(root, paths):
+        try:
+            sf = SourceFile.parse(abspath, relpath)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            result.errors.append(f"{relpath}: {e}")
+            continue
+        result.files_checked += 1
+        for f in lint_file(sf):
+            pairs.append((f, sf.line_text(f.line)))
+
+    if update_baseline:
+        entries = [f.fingerprint(text) for f, text in pairs]
+        write_baseline(baseline_path or DEFAULT_BASELINE, entries)
+        result.baselined = [f for f, _ in pairs]
+        return result
+
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    result.new, result.baselined = split_baselined(pairs, baseline)
+    result.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+def format_report(result: LintResult, verbose_baselined: bool = False) -> str:
+    out: List[str] = []
+    for f in result.new:
+        out.append(f"{f.location()}: [{f.rule}] {f.message}")
+    if verbose_baselined:
+        for f in sorted(
+            result.baselined, key=lambda f: (f.path, f.line, f.rule)
+        ):
+            out.append(f"{f.location()}: [{f.rule}] (baselined) {f.message}")
+    for e in result.errors:
+        out.append(f"error: {e}")
+    by_rule: Dict[str, int] = {}
+    for f in result.new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = (
+        f"{result.files_checked} files checked: "
+        f"{len(result.new)} finding(s)"
+        + (f" ({', '.join(f'{n} {r}' for r, n in sorted(by_rule.items()))})"
+           if by_rule else "")
+        + (f", {len(result.baselined)} baselined" if result.baselined else "")
+    )
+    out.append(summary)
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None, root: Optional[str] = None) -> int:
+    """`babble-tpu lint` entry point (also `python -m babble_tpu lint`)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="babble-tpu lint",
+        description=(
+            "Consensus-grade static analysis: determinism lint, "
+            "lock-discipline checker, JAX staging audit (docs/analysis.md)"
+        ),
+    )
+    p.add_argument("paths", nargs="*",
+                   help="Files or directories to lint (default: babble_tpu/)")
+    p.add_argument("--baseline", default=None,
+                   help="Baseline file (default: the checked-in "
+                        "babble_tpu/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="Report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="Accept all current findings into the baseline file")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="Also list suppressed (baselined) findings")
+    args = p.parse_args(argv)
+
+    root = root or os.getcwd()
+    if not args.paths and not os.path.isdir(os.path.join(root, "babble_tpu")):
+        # not run from a source checkout (e.g. the docker image, where only
+        # the installed wheel exists): lint the installed package instead
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline_path = None
+    result = run_lint(
+        root,
+        paths=args.paths or None,
+        baseline_path=baseline_path,
+        update_baseline=args.write_baseline,
+    )
+    if args.write_baseline:
+        print(
+            f"baseline written: {len(result.baselined)} finding(s) accepted"
+        )
+        return 0
+    print(format_report(result, verbose_baselined=args.show_baselined))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

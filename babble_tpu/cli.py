@@ -118,6 +118,16 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--log", default="error", choices=sorted(LOG_LEVELS),
                      help="Log level for the simulated nodes")
 
+    # `lint` is dispatched before the main parse (main()): the analysis
+    # runner owns its own argparse, and argparse.REMAINDER inside a
+    # subparser mis-handles leading optionals. Registered here so it
+    # shows up in --help.
+    sub.add_parser(
+        "lint",
+        help="Consensus-grade static analysis (docs/analysis.md)",
+        add_help=False,
+    )
+
     sub.add_parser("version", help="Show version info")
     return p
 
@@ -291,6 +301,11 @@ def keygen_command(args: argparse.Namespace) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        from .analysis import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.command == "run":
         _merge_config_file(args, argv)

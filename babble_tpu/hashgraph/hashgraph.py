@@ -1010,10 +1010,12 @@ class Hashgraph:
             ("ProcessDecidedRounds", self.process_decided_rounds),
             ("ProcessSigPool", self.process_sig_pool),
         ):
-            start = time.monotonic()
+            # perf_counter, not monotonic: duration-only instrumentation
+            # (det-wallclock exempts it — it cannot feed a schedule)
+            start = time.perf_counter()
             pass_()
             self.logger.debug(
-                "%s() duration=%dns", name, int((time.monotonic() - start) * 1e9)
+                "%s() duration=%dns", name, int((time.perf_counter() - start) * 1e9)
             )
 
     # ------------------------------------------------------------------

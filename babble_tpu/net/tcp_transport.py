@@ -114,9 +114,9 @@ class TCPTransport(Transport):
         self.max_frame_size = max_frame_size
         self.max_inbound = max_inbound
         self._consumer: "queue.Queue[RPC]" = queue.Queue()
-        self._pool: Dict[str, List[socket.socket]] = {}
+        self._pool: Dict[str, List[socket.socket]] = {}  # guarded-by: _pool_lock
         self._pool_lock = threading.Lock()
-        self._inbound: List[socket.socket] = []
+        self._inbound: List[socket.socket] = []  # guarded-by: _pool_lock
         self._shutdown = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._listen, name=f"tcp-accept-{self._addr}", daemon=True

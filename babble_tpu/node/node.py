@@ -98,7 +98,7 @@ class Node(NodeStateMachine):
         )
         self.core_lock = threading.Lock()
         self.selector_lock = threading.Lock()
-        self.peer_selector = RandomPeerSelector(
+        self.peer_selector = RandomPeerSelector(  # guarded-by: selector_lock
             participants, self.local_addr, rng=conf.rng
         )
         self.trans = trans
@@ -135,7 +135,7 @@ class Node(NodeStateMachine):
         # sync responses (code review r5 found the sampled-ack version
         # unsound; the all-peers version then proved liveness-fragile:
         # one unreachable peer blocked recovery forever)
-        self._last_exported_seq = -1
+        self._last_exported_seq = -1  # guarded-by: _export_lock
         self._export_lock = threading.Lock()
         # highest block index the APP has committed (proxy.commit_block
         # returned). The hashgraph's anchor can run a full commit channel
@@ -261,7 +261,8 @@ class Node(NodeStateMachine):
                 # exchange time).
                 proceed = self._pre_gossip() if self._gossip_inflight == 0 else False
                 if proceed:
-                    peer = self.peer_selector.next()
+                    with self.selector_lock:
+                        peer = self.peer_selector.next()
                     self._gossip_inflight += 1
 
                     def _exchange(addr=peer.net_addr):
@@ -560,7 +561,8 @@ class Node(NodeStateMachine):
         self.logger.debug("IN CATCHING-UP STATE")
         self.wait_routines()
 
-        peer = self.peer_selector.next()
+        with self.selector_lock:
+            peer = self.peer_selector.next()
         try:
             resp = self.trans.fast_forward(
                 peer.net_addr, FastForwardRequest(from_id=self.id)
@@ -604,14 +606,15 @@ class Node(NodeStateMachine):
                 # local evidence: no dependency on sampling every peer's
                 # responses (unsound) or hearing from every peer (blocks
                 # recovery when one is unreachable).
-                if self._rewind_ok and self._last_exported_seq <= my_frame_idx:
+                with self._export_lock:
+                    exported_bound = self._last_exported_seq
+                if self._rewind_ok and exported_bound <= my_frame_idx:
                     self.logger.warning(
                         "fast_forward: accepting own-chain rewind (seq %d"
                         " > frame %d) — store is unservable and nothing "
                         "above own index %d was ever exported; discarding"
                         " the tail is the only recovery",
-                        self.core.seq, my_frame_idx,
-                        self._last_exported_seq,
+                        self.core.seq, my_frame_idx, exported_bound,
                     )
                 else:
                     self._count_bounce(
@@ -783,6 +786,7 @@ class Node(NodeStateMachine):
             "consensus_transactions": str(self.core.get_consensus_transactions_count()),
             "undetermined_events": str(len(self.core.get_undetermined_events())),
             "transaction_pool": str(len(self.core.transaction_pool)),
+            # unguarded-ok: peers() copies a list; stats tolerate staleness
             "num_peers": str(len(self.peer_selector.peers())),
             "sync_rate": f"{self.sync_rate():.2f}",
             "events_per_second": f"{events_per_second:.2f}",

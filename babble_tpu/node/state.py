@@ -22,10 +22,10 @@ class NodeState(enum.Enum):
 
 class NodeStateMachine:
     def __init__(self):
-        self._state = NodeState.BABBLING
-        self._starting = False
+        self._state = NodeState.BABBLING  # guarded-by: _lock
+        self._starting = False  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._routines = 0
+        self._routines = 0  # guarded-by: _cv
         self._cv = threading.Condition()
 
     def get_state(self) -> NodeState:
@@ -61,4 +61,5 @@ class NodeStateMachine:
 
     def wait_routines(self, timeout: float = 30.0) -> None:
         with self._cv:
+            # unguarded-ok: wait_for re-acquires _cv before each predicate call
             self._cv.wait_for(lambda: self._routines == 0, timeout=timeout)

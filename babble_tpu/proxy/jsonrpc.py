@@ -12,9 +12,9 @@ from __future__ import annotations
 import json
 import socket
 import threading
-import time
 from typing import Any, Callable, Dict, Optional
 
+from ..common import Clock, SYSTEM_CLOCK
 from ..utils.netaddr import split_hostport
 
 
@@ -72,18 +72,22 @@ class JSONRPCClient:
 
     def __init__(self, addr: str, timeout: float = 5.0,
                  max_line: Optional[int] = None,
-                 idle_reconnect: float = DEFAULT_IDLE_RECONNECT):
+                 idle_reconnect: float = DEFAULT_IDLE_RECONNECT,
+                 clock: Clock = SYSTEM_CLOCK):
         self.addr = addr
         self.timeout = timeout
         self.max_line = DEFAULT_MAX_LINE if max_line is None else max_line
         self.idle_reconnect = idle_reconnect
-        self._sock: Optional[socket.socket] = None
-        self._rfile = None
-        self._next_id = 0
-        self._last_used = 0.0
+        # connection-age reads ride the injected Clock so a simulated
+        # node's virtual time governs idle-reconnect decisions too
+        self._clock = clock
+        self._sock: Optional[socket.socket] = None  # guarded-by: _lock
+        self._rfile = None  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
+        self._last_used = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
-    def _connect(self) -> None:
+    def _connect(self) -> None:  # requires-lock: _lock
         host, port = split_hostport(self.addr)
         self._sock = socket.create_connection((host, port), timeout=self.timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -94,7 +98,8 @@ class JSONRPCClient:
             # proactive recycle of idle connections (see class docstring)
             if (
                 self._sock is not None
-                and time.monotonic() - self._last_used >= self.idle_reconnect
+                and self._clock.monotonic() - self._last_used
+                >= self.idle_reconnect
             ):
                 self.close_locked()
             if self._sock is None:
@@ -118,7 +123,7 @@ class JSONRPCClient:
                 )
             try:
                 self._sock.sendall(msg)
-                self._last_used = time.monotonic()
+                self._last_used = self._clock.monotonic()
                 line = self._rfile.readline(self.max_line + 2)
                 if not line:
                     raise ConnectionError("connection closed")
@@ -127,7 +132,7 @@ class JSONRPCClient:
                 raise JSONRPCError(
                     f"rpc {method} to {self.addr}: {exc}"
                 ) from exc
-            self._last_used = time.monotonic()
+            self._last_used = self._clock.monotonic()
             if not line.endswith(b"\n") or len(line) > self.max_line + 1:
                 # bounded read: a server streaming an endless response
                 # line must not grow our memory without limit
@@ -140,7 +145,7 @@ class JSONRPCClient:
                 raise JSONRPCError(str(resp["error"]))
             return resp.get("result")
 
-    def close_locked(self) -> None:
+    def close_locked(self) -> None:  # requires-lock: _lock
         if self._sock is not None:
             try:
                 self._sock.close()

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import logging
 import queue
+from typing import Optional
 
+from ..common import Clock, SYSTEM_CLOCK
 from ..hashgraph import Block
 from ..utils.codec import b64d, b64e
 from .jsonrpc import JSONRPCClient, JSONRPCServer
@@ -26,11 +28,12 @@ class SocketAppProxy(AppProxy):
         client_addr: str,
         bind_addr: str,
         timeout: float = 5.0,
-        logger: logging.Logger = None,
+        logger: Optional[logging.Logger] = None,
+        clock: Clock = SYSTEM_CLOCK,
     ):
         self.logger = logger or logging.getLogger("socket_app_proxy")
         self._submit_ch: "queue.Queue[bytes]" = queue.Queue()
-        self.client = JSONRPCClient(client_addr, timeout=timeout)
+        self.client = JSONRPCClient(client_addr, timeout=timeout, clock=clock)
         self.server = JSONRPCServer(bind_addr)
         self.server.register("Babble.SubmitTx", self._handle_submit_tx)
         self.server.start()

@@ -11,7 +11,9 @@ The application holds a SocketBabbleProxy:
 from __future__ import annotations
 
 import logging
+from typing import Optional
 
+from ..common import Clock, SYSTEM_CLOCK
 from ..hashgraph import Block
 from ..utils.codec import b64d, b64e
 from .jsonrpc import JSONRPCClient, JSONRPCServer
@@ -25,11 +27,12 @@ class SocketBabbleProxy:
         bind_addr: str,
         handler: ProxyHandler,
         timeout: float = 5.0,
-        logger: logging.Logger = None,
+        logger: Optional[logging.Logger] = None,
+        clock: Clock = SYSTEM_CLOCK,
     ):
         self.logger = logger or logging.getLogger("socket_babble_proxy")
         self.handler = handler
-        self.client = JSONRPCClient(node_addr, timeout=timeout)
+        self.client = JSONRPCClient(node_addr, timeout=timeout, clock=clock)
         self.server = JSONRPCServer(bind_addr)
         self.server.register("State.CommitBlock", self._handle_commit)
         self.server.register("State.GetSnapshot", self._handle_snapshot)
@@ -69,7 +72,8 @@ class DummySocketClient:
     (reference: src/proxy/dummy/socket_dummy.go)."""
 
     def __init__(
-        self, node_addr: str, bind_addr: str, logger: logging.Logger = None
+        self, node_addr: str, bind_addr: str,
+        logger: Optional[logging.Logger] = None,
     ):
         from .dummy import State
 

@@ -18,12 +18,12 @@ import json
 import logging
 import sys
 import threading
-import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from .common import Clock, SYSTEM_CLOCK
 from .utils.netaddr import split_hostport
 
 
@@ -40,14 +40,18 @@ def thread_stacks() -> str:
 _profile_lock = threading.Lock()
 
 
-def profile_process(seconds: float, hz: float = 100.0) -> str:
+def profile_process(
+    seconds: float, hz: float = 100.0, clock: Clock = SYSTEM_CLOCK
+) -> str:
     """Sampling profiler over EVERY thread in the process: collect each
     thread's current stack `hz` times a second for `seconds` via
     sys._current_frames (cProfile's tracing hooks only instrument the
     installing thread, which would profile the HTTP handler instead of
     the node), then render the hottest frames and hottest whole stacks —
     the CPU-profile analog of the reference's pprof endpoint. One
-    profile at a time."""
+    profile at a time. The wait deadline rides the injected Clock so a
+    simulated node's virtual time governs it like every other wait in
+    the node layer."""
     if not _profile_lock.acquire(blocking=False):
         return "profile already running\n"
     try:
@@ -55,9 +59,9 @@ def profile_process(seconds: float, hz: float = 100.0) -> str:
         frame_hits: dict = {}
         stack_hits: dict = {}
         period = 1.0 / hz
-        deadline = time.monotonic() + seconds
+        deadline = clock.monotonic() + seconds
         samples = 0
-        while time.monotonic() < deadline:
+        while clock.monotonic() < deadline:
             for ident, frame in sys._current_frames().items():
                 if ident == me:
                     continue
@@ -75,7 +79,7 @@ def profile_process(seconds: float, hz: float = 100.0) -> str:
                 key = tuple(stack)
                 stack_hits[key] = stack_hits.get(key, 0) + 1
             samples += 1
-            time.sleep(period)
+            clock.sleep(period)
         out = [f"{samples} samples over {seconds:.1f}s at {hz:.0f} Hz\n"]
         out.append("hottest frames (samples, location):")
         for loc, n in sorted(frame_hits.items(), key=lambda kv: -kv[1])[:40]:
@@ -96,6 +100,7 @@ class Service:
         node,
         logger: Optional[logging.Logger] = None,
         remote_debug: bool = False,
+        clock: Optional[Clock] = None,
     ):
         self.bind_address = bind_address
         self.node = node
@@ -105,8 +110,16 @@ class Service:
         # opted in (the stats port is often network-reachable; pprof
         # exposure is restricted the same way in production Go services)
         self.remote_debug = remote_debug
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        # default to the node's injected clock: the profiler's sampling
+        # deadline then follows the same (possibly virtual) time source
+        # as the node it profiles
+        self.clock: Clock = clock or getattr(node, "clock", SYSTEM_CLOCK)
+        # serve/shutdown may race (engine run thread vs operator signal
+        # handler); the lifecycle state is lock-guarded and the lint's
+        # guarded-by checker enforces the discipline
+        self._lifecycle_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None  # guarded-by: _lifecycle_lock
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lifecycle_lock
 
     def debug_allowed(self, client_ip: str) -> bool:
         return self.remote_debug or client_ip in (
@@ -115,8 +128,9 @@ class Service:
 
     def serve(self) -> None:
         """Start serving in a background thread (idempotent)."""
-        if self._httpd is not None:
-            return
+        with self._lifecycle_lock:
+            if self._httpd is not None:
+                return
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -143,7 +157,8 @@ class Service:
                             q = parse_qs(urlparse(self.path).query)
                             secs = float(q.get("seconds", ["5"])[0])
                             body = profile_process(
-                                min(max(secs, 0.1), 60.0)
+                                min(max(secs, 0.1), 60.0),
+                                clock=service.clock,
                             ).encode()
                             ctype = "text/plain"
                         else:
@@ -165,21 +180,29 @@ class Service:
                 service.logger.debug("service: " + fmt, *args)
 
         host, port = split_hostport(self.bind_address)
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="babble-service", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle_lock:
+            if self._httpd is not None:
+                return  # raced another serve(): the first bind wins
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="babble-service",
+                daemon=True,
+            )
+            self._thread.start()
         self.logger.debug("Service serving on %s", self.local_addr())
 
     def local_addr(self) -> str:
-        if self._httpd is None:
-            return self.bind_address
-        host, port = self._httpd.server_address[:2]
+        with self._lifecycle_lock:
+            if self._httpd is None:
+                return self.bind_address
+            host, port = self._httpd.server_address[:2]
         return f"{host}:{port}"
 
     def shutdown(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        with self._lifecycle_lock:
+            httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            # shutdown() blocks until serve_forever exits — done outside
+            # the lock so a concurrent local_addr() cannot queue behind it
+            httpd.shutdown()
+            httpd.server_close()

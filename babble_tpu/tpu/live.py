@@ -213,6 +213,7 @@ class LiveDeviceEngine:
         undet = set(hg.undetermined_events)
         # stop the walk-back only below every undetermined event's round
         stop = base
+        # det-ok: pure min-reduction over the set — order-independent
         for h in undet:
             try:
                 ev = hg.store.get_event(h)

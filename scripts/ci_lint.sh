@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Static-analysis CI gate (docs/analysis.md) — deliberately SEPARATE from
+# the tier-1 pytest gate so a lint finding never masks (or is masked by)
+# a test regression.
+#
+# Tier 1 (hard, stdlib-only): the consensus-grade analyzers in
+#   babble_tpu/analysis/ — determinism lint, lock-discipline checker,
+#   JAX staging audit. New findings (not in the checked-in baseline)
+#   fail the build.
+# Tier 2 (advisory): ruff/mypy per the pyproject.toml baseline config,
+#   run only where installed (pip install -e '.[lint]'); absence is a
+#   skip, not a failure, because the node image ships without them.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== babble-tpu lint (hard gate) =="
+python -m babble_tpu lint || rc=1
+
+echo "== ruff (advisory) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"
+else
+    echo "ci_lint: ruff not installed — skipped"
+fi
+
+echo "== mypy (advisory) =="
+if command -v mypy >/dev/null 2>&1; then
+    mypy --config-file pyproject.toml || echo "ci_lint: mypy reported findings (advisory)"
+else
+    echo "ci_lint: mypy not installed — skipped"
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "ci_lint: FAIL (new static-analysis findings — see above;"
+    echo "  fix, waive with a reasoned # <tag>-ok: comment, or baseline"
+    echo "  via 'python -m babble_tpu lint --write-baseline')"
+else
+    echo "ci_lint: PASS"
+fi
+exit "$rc"
