@@ -10,8 +10,9 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # consensus-grade static analysis (babble_tpu/analysis/, docs/analysis.md):
-# determinism lint + lock-discipline checker + JAX staging audit. Hard
-# gate. ruff/mypy are an advisory second tier — they run only where
+# determinism lint + lock-discipline checker + JAX staging audit +
+# observability lint (obs-*: static metric names, literal label sets).
+# Hard gate. ruff/mypy are an advisory second tier — they run only where
 # installed (pip install -e '.[lint]'); the container image does not
 # ship them.
 lint:

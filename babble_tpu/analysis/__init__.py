@@ -1,13 +1,15 @@
 """Consensus-grade static analysis (docs/analysis.md).
 
-Three AST checker families over the package source:
+Four AST checker families over the package source:
 
 - determinism lint (determinism.py): wall-clock/RNG/set-order/hash()
   nondeterminism that would diverge replicas computing the same DAG;
 - lock-discipline checker (locks.py): `# guarded-by:` race detection for
   shared attributes in the threaded node/net/proxy runtime;
 - JAX staging audit (staging.py): tracer-hostile Python inside
-  `jax.jit`-staged device kernels.
+  `jax.jit`-staged device kernels;
+- observability lint (obs.py): metric declarations must use static
+  string names and literal, bounded label sets (`obs-*` rules).
 
 Run via `babble-tpu lint` / `make lint`; the checked-in baseline
 (baseline.json) pins accepted findings so the gate stays green while
@@ -19,6 +21,7 @@ correctness story.
 from .core import Finding, SourceFile, load_baseline, write_baseline
 from .determinism import check_determinism
 from .locks import check_locks
+from .obs import check_obs
 from .runner import LintResult, format_report, lint_file, main, run_lint
 from .staging import check_staging, find_staged_functions
 
@@ -28,6 +31,7 @@ __all__ = [
     "LintResult",
     "check_determinism",
     "check_locks",
+    "check_obs",
     "check_staging",
     "find_staged_functions",
     "format_report",

@@ -1,0 +1,111 @@
+"""Observability lint: the metrics surface must be statically knowable
+(docs/analysis.md, docs/observability.md).
+
+A metric whose name is computed at runtime (f-string, concatenation,
+variable) defeats every downstream consumer — dashboards, alerts, the
+catalog in docs/observability.md — and can grow the registry without
+bound. Same for label sets: the registry bounds *values* per declared
+label (MAX_LABEL_SETS), but only if the label *names* are declared as
+literals the reviewer can read.
+
+Rules (waiver tag `obs-ok`):
+
+- obs-dynamic-name — a metric declaration (`*.counter/gauge/histogram`
+  on an obs/registry receiver) whose name argument is not a string
+  literal.
+- obs-label-decl  — a declaration whose `labels=` argument is not a
+  literal tuple/list of string literals.
+
+Scope: any call `<recv>.counter|gauge|histogram(...)` where the receiver
+chain ends in `obs`, `registry`, `reg` or `metrics` — the conventional
+handles for the per-node Observability bundle and its MetricsRegistry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import Finding, SourceFile, SymbolTracker, dotted_name
+
+WAIVER = "obs-ok"
+
+DECL_METHODS = {"counter", "gauge", "histogram"}
+RECEIVER_TAILS = {"obs", "registry", "reg", "metrics"}
+
+
+def _is_str_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
+def _literal_label_tuple(node: ast.AST) -> bool:
+    """A literal tuple/list whose elements are all string literals."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return False
+    return all(_is_str_literal(el) for el in node.elts)
+
+
+def _decl_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a declaring call, or None when this is not
+    a metric declaration we police (e.g. `df.histogram(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in RECEIVER_TAILS else None
+
+
+class _ObsVisitor(SymbolTracker):
+    def __init__(self, sf: SourceFile) -> None:
+        super().__init__()
+        self.sf = sf
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = node.lineno
+        if self.sf.has_waiver(line, WAIVER):
+            return
+        self.findings.append(
+            Finding(rule=rule, path=self.sf.path, line=line,
+                    message=message, symbol=self.symbol)
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in DECL_METHODS:
+            recv = _decl_receiver(func)
+            if recv is not None:
+                self._check_decl(node, recv, func.attr)
+        self.generic_visit(node)
+
+    def _check_decl(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        labels_arg: Optional[ast.AST] = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+            elif kw.arg == "labels":
+                labels_arg = kw.value
+
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-dynamic-name", node,
+                f"{recv}.{method}(...) declares a metric with a computed "
+                "name; metric names must be static string literals so the "
+                "catalog (docs/observability.md), dashboards and the "
+                "registry's cardinality stay statically knowable",
+            )
+        if labels_arg is not None and not _literal_label_tuple(labels_arg):
+            self._emit(
+                "obs-label-decl", node,
+                f"{recv}.{method}(...) declares labels that are not a "
+                "literal tuple/list of string literals; label names must "
+                "be declared statically (values are bounded at runtime by "
+                "MAX_LABEL_SETS, but only per declared label name)",
+            )
+
+
+def check_obs(sf: SourceFile) -> Iterable[Finding]:
+    visitor = _ObsVisitor(sf)
+    visitor.visit(sf.tree)
+    return visitor.findings

@@ -1,5 +1,5 @@
 """Lint driver: discovers package sources, classifies their scope, runs
-the three checker families, applies the baseline and formats the report
+the four checker families, applies the baseline and formats the report
 (docs/analysis.md). The CLI (`babble-tpu lint`) and `make lint` both land
 here; tests drive `run_lint` directly.
 """
@@ -20,6 +20,7 @@ from .core import (
 )
 from .determinism import check_determinism
 from .locks import check_locks
+from .obs import check_obs
 from .staging import check_staging
 
 # modules where replica-identical computation is decided: the five-pass
@@ -101,6 +102,7 @@ def lint_file(sf: SourceFile) -> List[Finding]:
             sf, consensus_critical=_matches(sf.path, CONSENSUS_CRITICAL_PREFIXES)
         )
     )
+    findings.extend(check_obs(sf))
     if _matches(sf.path, LOCK_SCOPE_PREFIXES):
         findings.extend(check_locks(sf))
     if _matches(sf.path, STAGING_SCOPE_PREFIXES):

@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--mesh-devices", type=int, default=0,
                      help="With --consensus-backend=tpu: shard the device "
                           "passes over this many chips (0 = single device)")
+    run.add_argument("--metrics", action="store_true",
+                     help="Log periodic metrics-registry snapshots at info "
+                          "(the registry always serves GET /metrics on the "
+                          "HTTP service regardless)")
 
     kg = sub.add_parser("keygen", help="Create new key pair")
     kg.add_argument("--datadir", default=default_data_dir(),
@@ -174,7 +178,7 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "service-remote-debug": "service_remote_debug", "store": "store",
         "cache-size": "cache_size", "heartbeat": "heartbeat",
         "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
-        "mesh-devices": "mesh_devices",
+        "mesh-devices": "mesh_devices", "metrics": "metrics",
     }
     for file_key, attr in mapping.items():
         if file_key in cfg and attr not in explicit:
@@ -214,6 +218,7 @@ def run_command(args: argparse.Namespace) -> int:
             sync_limit=args.sync_limit,
             consensus_backend=args.consensus_backend,
             mesh_devices=args.mesh_devices,
+            metrics_log=args.metrics,
             logger=logger,
         ),
     )

@@ -63,8 +63,11 @@ class Hashgraph:
         store: Store,
         commit_callback: Optional[Callable[[Block], None]] = None,
         logger=None,
+        obs=None,
     ):
         import logging
+
+        from ..obs import Observability
 
         n = len(participants)
         self.participants = participants
@@ -73,6 +76,15 @@ class Hashgraph:
         self.super_majority = 2 * n // 3 + 1
         self.trust_count = math.ceil(n / 3)
         self.logger = logger or logging.getLogger("babble.hashgraph")
+        # always present so the device engines can instrument without
+        # nil-guards; a Node passes its own bundle (sharing the injected
+        # clock), direct construction gets a private system-clock one
+        self.obs = obs if obs is not None else Observability()
+        self._pass_hist = self.obs.histogram(
+            "babble_consensus_pass_duration_seconds",
+            "Wall time of each consensus pipeline pass",
+            labels=("phase",),
+        )
 
         self.undetermined_events: List[str] = []
         self.pending_rounds: List[PendingRound] = []
@@ -999,24 +1011,27 @@ class Hashgraph:
                     self._sig_wait_commit.add(idx)
 
     def run_consensus(self) -> None:
-        """The full pipeline with per-pass timing logs
-        (reference: src/node/core.go:335-377)."""
-        import time
-
-        for name, pass_ in (
-            ("DivideRounds", self.divide_rounds),
-            ("DecideFame", self.decide_fame),
-            ("DecideRoundReceived", self.decide_round_received),
-            ("ProcessDecidedRounds", self.process_decided_rounds),
-            ("ProcessSigPool", self.process_sig_pool),
+        """The full pipeline with per-pass timing into the obs layer
+        (reference: src/node/core.go:335-377). Durations ride the
+        injected clock, not perf_counter, so the per-pass histograms are
+        byte-deterministic under the simulator's virtual time (where
+        every pass reads as zero-cost, which is exactly the sim's model)."""
+        clock = self.obs.clock
+        for name, phase, pass_ in (
+            ("DivideRounds", "divide_rounds", self.divide_rounds),
+            ("DecideFame", "decide_fame", self.decide_fame),
+            ("DecideRoundReceived", "decide_round_received",
+             self.decide_round_received),
+            ("ProcessDecidedRounds", "process_decided_rounds",
+             self.process_decided_rounds),
+            ("ProcessSigPool", "process_sig_pool", self.process_sig_pool),
         ):
-            # perf_counter, not monotonic: duration-only instrumentation
-            # (det-wallclock exempts it — it cannot feed a schedule)
-            start = time.perf_counter()
+            start = clock.monotonic()
             pass_()
-            self.logger.debug(
-                "%s() duration=%dns", name, int((time.perf_counter() - start) * 1e9)
-            )
+            dur = clock.monotonic() - start
+            self._pass_hist.labels(phase=phase).observe(dur)
+            self.obs.tracer.record("consensus." + phase, start, dur)
+            self.logger.debug("%s() duration=%dns", name, int(dur * 1e9))
 
     # ------------------------------------------------------------------
     # anchor / reset / bootstrap (reference: src/hashgraph/hashgraph.go:1302-1410)

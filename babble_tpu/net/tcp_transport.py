@@ -118,12 +118,33 @@ class TCPTransport(Transport):
         self._pool_lock = threading.Lock()
         self._inbound: List[socket.socket] = []  # guarded-by: _pool_lock
         self._shutdown = threading.Event()
+        # wire metrics: None until the owning node binds its obs bundle
+        # (a bare transport — tests, tools — records nothing)
+        self._m_frame_bytes = None
+        self._m_rpcs = None
         self._accept_thread = threading.Thread(
             target=self._listen, name=f"tcp-accept-{self._addr}", daemon=True
         )
         self._accept_thread.start()
 
     # ---- Transport interface ------------------------------------------
+
+    def bind_obs(self, obs) -> None:
+        """Declare the wire metrics against the node's registry. Metric
+        refs are cached so the frame hot path pays one attribute load."""
+        from ..obs import DEFAULT_SIZE_BUCKETS
+
+        self.obs = obs
+        self._m_frame_bytes = obs.histogram(
+            "babble_net_frame_bytes",
+            "Wire frame payload size by direction",
+            labels=("direction",), buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        self._m_rpcs = obs.counter(
+            "babble_net_rpcs_total",
+            "Outbound RPCs by verb and result",
+            labels=("rpc", "result"),
+        )
 
     def consumer(self) -> "queue.Queue[RPC]":
         return self._consumer
@@ -190,14 +211,29 @@ class TCPTransport(Transport):
         except OSError:
             pass
 
+    _RPC_NAMES = {
+        TAG_SYNC: "sync",
+        TAG_EAGER_SYNC: "eager_sync",
+        TAG_FAST_FORWARD: "fast_forward",
+    }
+
+    def _obs_rpc(self, tag: int, result: str) -> None:
+        if self._m_rpcs is not None:
+            self._m_rpcs.labels(
+                rpc=self._RPC_NAMES.get(tag, "unknown"), result=result
+            ).inc()
+
     def _generic_rpc(self, target: str, tag: int, req):
         try:
             conn = self._get_conn(target)
         except OSError as exc:
+            self._obs_rpc(tag, "connect_error")
             raise TransportError(f"failed to connect to {target}: {exc}") from exc
         try:
             conn.settimeout(self.timeout)
             body = json.dumps(req.to_json()).encode()
+            if self._m_frame_bytes is not None:
+                self._m_frame_bytes.labels(direction="sent").observe(len(body))
             _send_frame(conn, tag, body)
             status, payload = _recv_frame(conn, self.max_frame_size)
         except (OSError, ConnectionError, TransportError) as exc:
@@ -205,11 +241,16 @@ class TCPTransport(Transport):
                 conn.close()
             except OSError:
                 pass
+            self._obs_rpc(tag, "error")
             raise TransportError(f"rpc to {target} failed: {exc}") from exc
+        if self._m_frame_bytes is not None:
+            self._m_frame_bytes.labels(direction="received").observe(len(payload))
         if status != 0:
             self._return_conn(target, conn)
+            self._obs_rpc(tag, "rejected")
             raise TransportError(payload.decode("utf-8", "replace"))
         self._return_conn(target, conn)
+        self._obs_rpc(tag, "ok")
         return _RESP_TYPES[tag].from_json(json.loads(payload))
 
     # ---- server side ---------------------------------------------------
@@ -239,6 +280,10 @@ class TCPTransport(Transport):
         try:
             while not self._shutdown.is_set():
                 tag, body = _recv_frame(sock, self.max_frame_size)
+                if self._m_frame_bytes is not None:
+                    self._m_frame_bytes.labels(
+                        direction="received"
+                    ).observe(len(body))
                 req_type = _REQ_TYPES.get(tag)
                 if req_type is None:
                     _send_frame(sock, 1, f"unknown rpc tag {tag}".encode())
@@ -254,9 +299,12 @@ class TCPTransport(Transport):
                 if resp.error:
                     _send_frame(sock, 1, resp.error.encode())
                 else:
-                    _send_frame(
-                        sock, 0, json.dumps(resp.response.to_json()).encode()
-                    )
+                    out = json.dumps(resp.response.to_json()).encode()
+                    if self._m_frame_bytes is not None:
+                        self._m_frame_bytes.labels(
+                            direction="sent"
+                        ).observe(len(out))
+                    _send_frame(sock, 0, out)
         except (ConnectionError, OSError, json.JSONDecodeError, TransportError):
             pass
         finally:

@@ -47,6 +47,15 @@ class RPC:
 class Transport(ABC):
     """The gossip communication backend (reference: src/net/transport.go:25-44)."""
 
+    # observability bundle bound by the owning Node; None until bound
+    obs = None
+
+    def bind_obs(self, obs) -> None:
+        """Attach the node's observability bundle. The default keeps a
+        reference only; transports with a wire layer (TCP) override to
+        declare frame/RPC metrics."""
+        self.obs = obs
+
     @abstractmethod
     def consumer(self) -> "queue.Queue[RPC]":
         """Queue on which inbound RPCs are delivered."""

@@ -45,6 +45,12 @@ class Config:
     # the simulator passes a per-node random.Random seeded from the run
     # seed so replays reproduce every choice.
     rng: Optional[random.Random] = None
+    # minimum seconds between Node.log_stats() snapshot lines — the
+    # heartbeat fires every successful gossip exchange, which at test
+    # heartbeats would be hundreds of log records a second
+    stats_log_interval: float = 10.0
+    # log the registry snapshot at info (CLI --metrics); default debug
+    metrics_log: bool = False
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -31,6 +31,7 @@ class Core:
         logger: Optional[logging.Logger] = None,
         consensus_backend: str = "cpu",
         mesh_devices: int = 0,
+        obs=None,
     ):
         self.id = id_
         self.key = key
@@ -42,6 +43,7 @@ class Core:
             store,
             commit_callback=commit_ch.put if commit_ch is not None else None,
             logger=self.logger,
+            obs=obs,
         )
         self.participants = participants
         self.head: str = ""
@@ -370,11 +372,18 @@ class Core:
                         self._consensus_calls + self._live_backoff
                     )
                     self._drop_live_engine()
-                    log = (
-                        self.logger.info
-                        if isinstance(e, GridUnsupported)
-                        else self.logger.warning
-                    )
+                    # one log per TRANSITION (a demotion of an attached
+                    # engine): repeated failed re-attach attempts while
+                    # already demoted stay at debug so a permanently
+                    # unsupported state doesn't log every backoff window
+                    if attached:
+                        log = (
+                            self.logger.info
+                            if isinstance(e, GridUnsupported)
+                            else self.logger.warning
+                        )
+                    else:
+                        log = self.logger.debug
                     log(
                         "incremental device engine unavailable (%s); "
                         "one-shot device path, retry in %d calls",
@@ -394,11 +403,15 @@ class Core:
         self.hg.run_consensus()
 
     def _mark_device_down(self, what: str, e: Exception) -> None:
+        # info exactly once per up->down transition; retries that fail
+        # while already down only extend the backoff at debug
+        first = not self._device_down
         self._device_down = True
         self.device_consensus_fallbacks += 1
         self._device_backoff = min(self._device_backoff * 2, 256)
         self._device_retry_at = self._consensus_calls + self._device_backoff
-        self.logger.warning(
+        log = self.logger.info if first else self.logger.debug
+        log(
             "%s unsupported (%s); using CPU, retry in %d calls",
             what, e, self._device_backoff,
         )

@@ -1,0 +1,83 @@
+"""Unified observability layer (ISSUE 4).
+
+One `Observability` object per node bundles the three telemetry
+surfaces behind the injected Clock seam:
+
+- a typed `MetricsRegistry` (counters / gauges / log-bucketed
+  histograms with declared, bounded label sets) rendered as Prometheus
+  text at `GET /metrics`;
+- a bounded ring-buffer `SpanTracer` exporting Chrome trace-event JSON
+  at `GET /debug/trace`;
+- the `Clock` every instrumentation site must time through, so sim
+  sweeps produce byte-identical latency histograms for a given seed.
+
+Metric names are declared with static string literals only — the
+`obs-*` analysis rules (babble_tpu/analysis/obs.py) reject computed
+names and undeclared label sets at lint time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..common.clock import Clock, SYSTEM_CLOCK
+from .metrics import (
+    Counter,
+    DEFAULT_COUNT_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_SIZE_BUCKETS,
+    Gauge,
+    Histogram,
+    MAX_LABEL_SETS,
+    MetricsRegistry,
+    log_buckets,
+)
+from .trace import DEFAULT_SPAN_CAPACITY, Span, SpanTracer
+
+__all__ = [
+    "Observability",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "SpanTracer",
+    "Span",
+    "log_buckets",
+    "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS",
+    "DEFAULT_COUNT_BUCKETS",
+    "DEFAULT_SPAN_CAPACITY",
+    "MAX_LABEL_SETS",
+]
+
+
+class Observability:
+    """Per-node bundle of registry + tracer + the clock they time by."""
+
+    def __init__(self, clock: Optional[Clock] = None, node_id: int = 0,
+                 span_capacity: int = DEFAULT_SPAN_CAPACITY):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.node_id = node_id
+        self.registry = MetricsRegistry()
+        self.tracer = SpanTracer(clock=self.clock, capacity=span_capacity)
+
+    # Delegates so call sites read `obs.counter("...")`. The name flows
+    # through a parameter here, which the obs-dynamic-name rule cannot
+    # prove static — waived: the rule checks the *call sites*, which do
+    # pass literals.
+    def counter(self, name: str, help_text: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self.registry.counter(name, help_text, labels)  # obs-ok: delegate, name checked at call sites
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self.registry.gauge(name, help_text, labels)  # obs-ok: delegate, name checked at call sites
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Sequence[str] = (), buckets=None) -> Histogram:
+        return self.registry.histogram(name, help_text, labels, buckets=buckets)  # obs-ok: delegate, name checked at call sites
+
+    def span(self, name: str, histogram=None, **attrs):
+        """Context manager timing a block into the span ring (and an
+        optional histogram) via the injected clock."""
+        return self.tracer.span(name, histogram=histogram, **attrs)

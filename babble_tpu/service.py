@@ -5,7 +5,14 @@ riding the service mux (reference: cmd/babble/main.go:4):
 
 - GET /debug/stacks          — all-thread stack dump (goroutine-profile analog)
 - GET /debug/profile?seconds=N — sample every thread's stack for N seconds
-  (<=60) and return the hottest frames/stacks as text
+  (<=60) and return the hottest frames/stacks as text; add
+  `&format=collapsed` for folded-stack output (flamegraph.pl compatible)
+- GET /debug/trace           — recent obs spans as Chrome trace-event JSON
+
+and the Prometheus exposition of the node's typed metrics registry:
+
+- GET /metrics               — text format 0.0.4 (not loopback-gated;
+  it is the scrape target, like /stats)
 
 Runs a daemon ThreadingHTTPServer so `serve()` mirrors the reference's
 `go Service.Serve()` composition (babble.go:203-209) without blocking the
@@ -41,7 +48,8 @@ _profile_lock = threading.Lock()
 
 
 def profile_process(
-    seconds: float, hz: float = 100.0, clock: Clock = SYSTEM_CLOCK
+    seconds: float, hz: float = 100.0, clock: Clock = SYSTEM_CLOCK,
+    fmt: str = "text",
 ) -> str:
     """Sampling profiler over EVERY thread in the process: collect each
     thread's current stack `hz` times a second for `seconds` via
@@ -51,7 +59,9 @@ def profile_process(
     the CPU-profile analog of the reference's pprof endpoint. One
     profile at a time. The wait deadline rides the injected Clock so a
     simulated node's virtual time governs it like every other wait in
-    the node layer."""
+    the node layer. `fmt="collapsed"` instead renders folded stacks —
+    one `frame;frame;frame count` line per distinct stack, root first —
+    directly consumable by flamegraph.pl / speedscope."""
     if not _profile_lock.acquire(blocking=False):
         return "profile already running\n"
     try:
@@ -80,6 +90,15 @@ def profile_process(
                 stack_hits[key] = stack_hits.get(key, 0) + 1
             samples += 1
             clock.sleep(period)
+        if fmt == "collapsed":
+            lines = []
+            for stack, n in sorted(
+                stack_hits.items(), key=lambda kv: (-kv[1], kv[0])
+            ):
+                # stacks were captured leaf -> root; folded format is
+                # root-first, semicolon-joined, trailing sample count
+                lines.append(";".join(reversed(stack)) + f" {n}")
+            return "\n".join(lines) + "\n"
         out = [f"{samples} samples over {seconds:.1f}s at {hz:.0f} Hz\n"]
         out.append("hottest frames (samples, location):")
         for loc, n in sorted(frame_hits.items(), key=lambda kv: -kv[1])[:40]:
@@ -139,6 +158,13 @@ class Service:
                 try:
                     if self.path == "/stats":
                         body = json.dumps(service.node.get_stats()).encode()
+                    elif self.path == "/metrics":
+                        obs = getattr(service.node, "obs", None)
+                        if obs is None:
+                            self.send_error(404, "node has no obs registry")
+                            return
+                        body = obs.registry.expose().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif self.path.startswith("/block/"):
                         index = int(self.path[len("/block/"):])
                         body = json.dumps(
@@ -153,12 +179,24 @@ class Service:
                         if self.path == "/debug/stacks":
                             body = thread_stacks().encode()
                             ctype = "text/plain"
+                        elif self.path == "/debug/trace":
+                            obs = getattr(service.node, "obs", None)
+                            if obs is None:
+                                self.send_error(404, "node has no obs tracer")
+                                return
+                            body = json.dumps(
+                                obs.tracer.to_chrome_trace(
+                                    pid=getattr(service.node, "id", 0)
+                                )
+                            ).encode()
                         elif self.path.startswith("/debug/profile"):
                             q = parse_qs(urlparse(self.path).query)
                             secs = float(q.get("seconds", ["5"])[0])
+                            fmt = q.get("format", ["text"])[0]
                             body = profile_process(
                                 min(max(secs, 0.1), 60.0),
                                 clock=service.clock,
+                                fmt=fmt,
                             ).encode()
                             ctype = "text/plain"
                         else:

@@ -273,6 +273,10 @@ class SimCluster:
         gen = sn.gen
         sn.exchange_inflight = True
         node.sync_requests += 1
+        # same sync-duration/span instrumentation as the threaded
+        # _gossip: observed against virtual time, so two same-seed runs
+        # report byte-identical sync histograms
+        ex_start = self.clock.monotonic()
         with node.core_lock:
             known = node.core.known_events()
         self._trace(f"{sn.name} pull -> {peer_addr}")
@@ -281,6 +285,7 @@ class SimCluster:
             if sn.gen != gen or sn.crashed:
                 return
             sn.exchange_inflight = False
+            node._obs_sync(ex_start, "error", peer_addr)
             if node._gossip_fail(peer_addr, e):
                 sn.catchup_flips += 1
                 self._trace(f"{sn.name} -> CatchingUp (livelock escape)")
@@ -290,6 +295,7 @@ class SimCluster:
                 return
             if resp.sync_limit:
                 sn.exchange_inflight = False
+                node._obs_sync(ex_start, "ok", peer_addr)
                 sn.catchup_flips += 1
                 self._trace(f"{sn.name} SyncLimit from {peer_addr} -> CatchingUp")
                 node.set_state(NodeState.CATCHING_UP)
@@ -307,6 +313,7 @@ class SimCluster:
                 with node.core_lock:
                     if node.core.over_sync_limit(resp.known, node.conf.sync_limit):
                         sn.exchange_inflight = False
+                        node._obs_sync(ex_start, "ok", peer_addr)
                         node._gossip_ok(peer_addr)
                         return
                     diff = node.core.event_diff(resp.known)
@@ -329,6 +336,7 @@ class SimCluster:
             if sn.gen != gen or sn.crashed:
                 return
             sn.exchange_inflight = False
+            node._obs_sync(ex_start, "ok", peer_addr)
             node._gossip_ok(peer_addr)
             self._drain(sn)
 
@@ -501,8 +509,22 @@ class SimCluster:
             "catchup_flips": sum(sn.catchup_flips for sn in self.sns),
             "ff_attempts": sum(sn.ff_attempts for sn in self.sns),
             "net": dict(self.net.stats),
+            "commit_latency": self.latency_histograms(),
             "digest": self.digest(),
         }
+
+    def latency_histograms(self) -> Dict[str, Any]:
+        """Per-live-node commit-latency histogram snapshots, measured on
+        VIRTUAL time: deterministic — two runs of the same seed+plan
+        produce byte-identical snapshots (the obs counterpart of
+        digest())."""
+        out: Dict[str, Any] = {}
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            snap = sn.node.obs.registry.snapshot()
+            out[sn.name] = snap.get("babble_commit_latency_seconds")
+        return out
 
     def digest(self) -> str:
         """SHA-256 over every settled block body on every live node, in

@@ -446,39 +446,51 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
     path behind node.Config.mesh_devices."""
     from ..common import StoreErr, StoreErrType, is_store_err
     from ..hashgraph import RoundInfo, PendingRound
-    import time as _time
 
-    _t0 = _time.perf_counter()
+    obs, clock = hg.obs, hg.obs.clock
+    _t0 = clock.monotonic()
     grid = grid_from_hashgraph(hg)
-    _stage_s = _time.perf_counter() - _t0
+    _stage_s = clock.monotonic() - _t0
     if grid.e == 0:
         hg.process_decided_rounds()
         hg.process_sig_pool()
         return
+    # per-call staging-vs-device breakdown (VERDICT r4 #8): the one-shot
+    # restage is O(E) host work per call — the histograms make its cost
+    # visible in /metrics (and /stats reads them back through
+    # Node._mesh_stats) so the scaling model is measured, not asserted
+    _path = "mesh" if mesh is not None else "oneshot"
+    obs.histogram(
+        "babble_device_stage_seconds",
+        "Host staging (restage) time per device consensus call",
+        labels=("path",),
+    ).labels(path=_path).observe(_stage_s)
+    _m_run = obs.histogram(
+        "babble_device_run_seconds",
+        "Device wall time per device consensus call",
+        labels=("path",),
+    )
     if mesh is not None:
         from .sharded import sharded_frontier_passes, sharded_run_passes
 
-        _t1 = _time.perf_counter()
+        _t1 = clock.monotonic()
         if _frontier_safe(grid):
             res = sharded_frontier_passes(mesh, grid)
         else:
             res = sharded_run_passes(mesh, grid)
-        # per-call staging-vs-device breakdown for the mesh product path
-        # (VERDICT r4 #8): the one-shot restage is O(E) host work per call
-        # — the counters make its cost visible in /stats and in the
-        # multichip dryrun so the scaling model is measured, not asserted
-        hg._mesh_stage_seconds = getattr(hg, "_mesh_stage_seconds", 0.0) + _stage_s
-        hg._mesh_device_seconds = (
-            getattr(hg, "_mesh_device_seconds", 0.0) + _time.perf_counter() - _t1
-        )
-        hg._mesh_staged_events = grid.e
-        # calls LAST: /stats readers gate on it lock-free, so the other
-        # counters must exist before it becomes nonzero
-        hg._mesh_calls = getattr(hg, "_mesh_calls", 0) + 1
+        _m_run.labels(path="mesh").observe(clock.monotonic() - _t1)
+        obs.gauge(
+            "babble_mesh_staged_events",
+            "Events staged onto the mesh in the latest mesh call",
+        ).set(grid.e)
     elif _frontier_safe(grid):
+        _t1 = clock.monotonic()
         res = run_frontier_passes(grid, d_max=d_max)
+        _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
     else:
+        _t1 = clock.monotonic()
         res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
+        _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
     # validate the WHOLE batch before stamping anything: a partial stamp

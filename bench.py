@@ -8,10 +8,13 @@ The timed path is the round-frontier pipeline (babble_tpu/tpu/frontier.py);
 its results are asserted bit-equal to the level-scan engine path
 (run_passes) before the number is reported.
 
-Prints exactly one JSON line:
+Prints a metrics-registry snapshot line first (the obs-layer view of the
+run: per-iteration latency histogram + throughput gauge), then the
+headline as the LAST line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline is value / 1e6 (the BASELINE.json target, since the reference
-publishes no numbers of its own).
+publishes no numbers of its own). Drivers that parse the last stdout
+line keep working unchanged.
 
 Runs on whatever JAX platform is available (real TPU under the driver).
 """
@@ -173,6 +176,26 @@ def main():
     np.testing.assert_array_equal(np.asarray(out.received), res.received)
 
     events_per_sec = grid.e / elapsed
+
+    # obs-layer snapshot BEFORE the headline: the driver parses the last
+    # stdout line, so the headline must stay last
+    from babble_tpu.obs import Observability, log_buckets
+
+    obs = Observability()
+    bench_hist = obs.histogram(
+        "babble_bench_iteration_seconds",
+        "Per-iteration wall time of the benchmark device pipeline",
+        buckets=log_buckets(0.0001, 2.0, 20),
+    )
+    bench_hist.observe(elapsed)
+    obs.gauge(
+        "babble_bench_events_per_second",
+        "Benchmark throughput headline",
+    ).set(events_per_sec)
+    print(json.dumps(
+        {"metrics_snapshot": obs.registry.snapshot()}, sort_keys=True
+    ))
+
     print(
         json.dumps(
             {

@@ -5,8 +5,9 @@
 #
 # Tier 1 (hard, stdlib-only): the consensus-grade analyzers in
 #   babble_tpu/analysis/ — determinism lint, lock-discipline checker,
-#   JAX staging audit. New findings (not in the checked-in baseline)
-#   fail the build.
+#   JAX staging audit, observability lint (obs-* rules: metric names
+#   must be static literals, label sets declared literally). New
+#   findings (not in the checked-in baseline) fail the build.
 # Tier 2 (advisory): ruff/mypy per the pyproject.toml baseline config,
 #   run only where installed (pip install -e '.[lint]'); absence is a
 #   skip, not a failure, because the node image ships without them.

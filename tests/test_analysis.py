@@ -337,6 +337,52 @@ def test_jax_rules_only_inside_staged_functions(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# observability lint
+# ---------------------------------------------------------------------------
+
+
+def test_obs_dynamic_name_and_label_decl(tmp_path):
+    found = _findings(
+        tmp_path, "babble_tpu/utils/fixture.py", """\
+        def instrument(obs, kind, names):
+            obs.counter(f"babble_{kind}_total", "computed name")
+            obs.histogram("babble_ok_seconds", "y", labels=names)
+            good = obs.gauge("babble_fine", "z", labels=("state",))
+            return good
+        """,
+    )
+    assert sorted((f.rule, f.line) for f in found) == [
+        ("obs-dynamic-name", 2),
+        ("obs-label-decl", 3),
+    ]
+    assert "static string literals" in found[0].message
+
+
+def test_obs_rules_apply_package_wide_with_waiver(tmp_path):
+    # node/ and net/ are in scope too (the rules run wherever the
+    # determinism lint runs), and a reasoned obs-ok waiver suppresses
+    found = _findings(
+        tmp_path, "babble_tpu/node/fixture.py", """\
+        def decl(registry, suffix):
+            registry.counter("x_" + suffix, "a")  # obs-ok: fixture, bounded by caller enum
+            registry.counter("y_" + suffix, "b")
+        """,
+    )
+    assert [(f.rule, f.line) for f in found] == [("obs-dynamic-name", 3)]
+
+
+def test_obs_ignores_foreign_receivers(tmp_path):
+    # .histogram() on a non-obs receiver (e.g. a dataframe) is not ours
+    found = _findings(
+        tmp_path, "babble_tpu/utils/fixture.py", """\
+        def plot(df, col):
+            return df.histogram(col, bins=10)
+        """,
+    )
+    assert [f for f in found if f.rule.startswith("obs-")] == []
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------
 

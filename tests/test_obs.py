@@ -1,0 +1,204 @@
+"""Observability-layer tests (babble_tpu/obs/, docs/observability.md):
+bucket math, Prometheus exposition format, bounded label cardinality,
+registry get-or-create semantics, span-ring truncation, Chrome trace
+export shape, and the headline determinism property — two same-seed
+simulator runs produce byte-identical commit-latency histograms.
+"""
+
+import json
+
+import pytest
+
+from babble_tpu.obs import (
+    DEFAULT_LATENCY_BUCKETS,
+    MAX_LABEL_SETS,
+    Observability,
+    SpanTracer,
+    log_buckets,
+)
+from babble_tpu.obs.metrics import MetricsRegistry
+from babble_tpu.sim import SimClock, run_one
+
+
+# ----------------------------------------------------------------------
+# bucket math
+# ----------------------------------------------------------------------
+
+def test_log_buckets_geometric():
+    assert log_buckets(1, 2.0, 4) == (1.0, 2.0, 4.0, 8.0)
+    bs = log_buckets(0.001, 2.0, 17)
+    assert bs == DEFAULT_LATENCY_BUCKETS
+    assert bs[0] == 0.001 and bs[-1] == pytest.approx(65.536)
+    with pytest.raises(ValueError):
+        log_buckets(0, 2.0, 4)
+    with pytest.raises(ValueError):
+        log_buckets(1, 1.0, 4)
+
+
+def test_histogram_bucket_placement_and_render():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "x", buckets=(0.1, 1.0, 10.0))
+    # boundary values land in the bucket whose bound they equal (le is
+    # inclusive, as in Prometheus)
+    for v in (0.05, 0.1, 0.5, 1.0, 10.0, 99.0):
+        h.observe(v)
+    assert h.stats() == (6, pytest.approx(110.65))
+    text = reg.expose()
+    assert '# TYPE h_seconds histogram' in text
+    assert 'h_seconds_bucket{le="0.1"} 2' in text  # cumulative
+    assert 'h_seconds_bucket{le="1"} 4' in text
+    assert 'h_seconds_bucket{le="10"} 5' in text
+    assert 'h_seconds_bucket{le="+Inf"} 6' in text
+    assert 'h_seconds_count 6' in text
+    assert text.endswith("\n")
+
+
+def test_histogram_rejects_unsorted_buckets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("bad_h", "x", buckets=(1.0, 0.5))
+
+
+# ----------------------------------------------------------------------
+# exposition format + labels
+# ----------------------------------------------------------------------
+
+def test_counter_gauge_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "counted things", labels=("result",))
+    c.labels(result="ok").inc()
+    c.labels(result="ok").inc(2)
+    c.labels(result="error").inc()
+    g = reg.gauge("g_now", "a level")
+    g.set(2.5)
+    text = reg.expose()
+    assert "# HELP c_total counted things" in text
+    assert "# TYPE c_total counter" in text
+    assert 'c_total{result="error"} 1' in text
+    assert 'c_total{result="ok"} 3' in text
+    assert "# TYPE g_now gauge" in text
+    assert "g_now 2.5" in text
+    # integral floats render without the dot
+    g.set(4.0)
+    assert "g_now 4\n" in reg.expose()
+    with pytest.raises(ValueError):
+        c.labels(result="ok").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc()  # unlabeled use of a labeled metric
+
+
+def test_gauge_set_function_is_read_at_render():
+    reg = MetricsRegistry()
+    box = {"v": 1.0}
+    reg.gauge("live_g", "x").set_function(lambda: box["v"])
+    assert "live_g 1" in reg.expose()
+    box["v"] = 7.0
+    assert "live_g 7" in reg.expose()
+    # a broken callback degrades to 0, never breaks the scrape
+    reg.gauge("live_g", "x").set_function(lambda: 1 / 0)
+    assert "live_g 0" in reg.expose()
+
+
+def test_label_overflow_collapses_to_other():
+    reg = MetricsRegistry()
+    c = reg.counter("many_total", "x", labels=("peer",))
+    for i in range(MAX_LABEL_SETS + 10):
+        c.labels(peer=f"p{i}").inc()
+    assert c.value(peer="p0") == 1.0
+    assert c.value(peer="other") == 10.0  # overflow series absorbs the rest
+    text = reg.expose()
+    assert text.count("many_total{") == MAX_LABEL_SETS + 1
+
+
+def test_registry_get_or_create_and_mismatch():
+    reg = MetricsRegistry()
+    c1 = reg.counter("same_total", "x")
+    assert reg.counter("same_total") is c1
+    assert reg.get("same_total") is c1
+    assert reg.get("nope") is None
+    with pytest.raises(ValueError):
+        reg.gauge("same_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("same_total", labels=("a",))  # label-set mismatch
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", labels=("bad-label",))
+
+
+def test_snapshot_flat_shapes():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "x", labels=("k",)).labels(k="a").inc(3)
+    reg.histogram("h_s", "x", buckets=(1.0,)).observe(0.5)
+    flat = reg.snapshot_flat()
+    assert flat["c_total{a}"] == 3
+    assert flat["h_s_count"] == 1
+    assert flat["h_s_sum"] == 0.5
+
+
+# ----------------------------------------------------------------------
+# span tracer
+# ----------------------------------------------------------------------
+
+def test_span_ring_truncates_oldest():
+    tracer = SpanTracer(capacity=8)
+    for i in range(20):
+        tracer.record(f"s{i}", float(i), 0.5)
+    spans = tracer.spans()
+    assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+    assert tracer.dropped == 12
+
+
+def test_span_context_manager_times_through_clock():
+    clock = SimClock()
+    obs = Observability(clock=clock)
+    h = obs.histogram("span_h_seconds", "x")
+    with obs.span("work", histogram=h, phase="p1"):
+        clock.now += 0.25
+    [sp] = obs.tracer.spans()
+    assert sp.name == "work"
+    assert sp.duration == 0.25
+    assert sp.attrs == {"phase": "p1"}
+    assert h.stats() == (1, 0.25)
+
+
+def test_chrome_trace_export_shape():
+    tracer = SpanTracer(capacity=8)
+    tracer.record("a", 1.0, 0.5, {"k": "v"})
+    tracer.record("b", 2.0, 0.25)
+    doc = tracer.to_chrome_trace(pid=3)
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert len(meta) == 1 and meta[0]["name"] == "thread_name"
+    assert [e["name"] for e in spans] == ["a", "b"]
+    assert spans[0]["ts"] == 1e6 and spans[0]["dur"] == 5e5  # microseconds
+    assert spans[0]["args"] == {"k": "v"}
+    assert all(e["pid"] == 3 for e in evs)
+    json.dumps(doc)  # must be directly serializable
+
+
+# ----------------------------------------------------------------------
+# headline determinism: same-seed sim runs give byte-identical
+# commit-latency histograms (ISSUE 4 acceptance)
+# ----------------------------------------------------------------------
+
+def test_sim_commit_latency_histogram_deterministic():
+    a = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    b = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    assert a["ok"] and b["ok"]
+    # the histograms actually measured something: every live node saw
+    # commits for transactions it submitted itself
+    counts = [
+        series["count"]
+        for snap in a["commit_latency"].values()
+        for series in snap["series"].values()
+    ]
+    assert counts and all(c > 0 for c in counts)
+    # and the whole snapshot — counts, sums, bucket assignment — is
+    # byte-identical across the two runs
+    assert (
+        json.dumps(a["commit_latency"], sort_keys=True)
+        == json.dumps(b["commit_latency"], sort_keys=True)
+    )

@@ -110,6 +110,62 @@ def test_config_file_merge_flags_win(tmp_path):
     assert args.heartbeat == 2.0
 
 
+def test_service_metrics_and_trace():
+    """GET /metrics (Prometheus text exposition from the node's registry)
+    and GET /debug/trace (Chrome trace-event JSON from the span ring) —
+    the scrape/trace surface of ISSUE 4."""
+    nodes, proxies = init_nodes(2)
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        run_nodes(nodes)
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+        bombard_and_wait(nodes, proxies, target_block=1)
+
+        req = urllib.request.urlopen(base + "/metrics", timeout=5)
+        assert req.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"
+        )
+        text = req.read().decode()
+        # headline + subsystem histograms declared, with valid shape
+        for name in (
+            "babble_commit_latency_seconds",
+            "babble_sync_duration_seconds",
+            "babble_consensus_pass_duration_seconds",
+            "babble_device_dispatch_seconds",
+            "babble_device_fetch_seconds",
+        ):
+            assert f"# TYPE {name} histogram" in text, name
+        assert "# TYPE babble_blocks_committed_total counter" in text
+        assert "# TYPE babble_last_block_index gauge" in text
+        # the commit actually landed in the headline histogram
+        count_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("babble_commit_latency_seconds_count")
+        ]
+        assert count_lines and int(count_lines[0].split()[-1]) >= 1
+        assert 'le="+Inf"' in text
+        # consensus passes ran and were labeled by phase
+        assert (
+            'babble_consensus_pass_duration_seconds_count'
+            '{phase="divide_rounds"}'
+        ) in text
+
+        trace = _get(base + "/debug/trace")
+        assert trace["displayTimeUnit"] == "ms"
+        evs = trace["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert xs, "no spans recorded during a committing run"
+        names = {e["name"] for e in xs}
+        assert "commit" in names
+        assert any(n.startswith("consensus.") for n in names)
+        assert any(e["ph"] == "M" and e["name"] == "thread_name"
+                   for e in evs)
+    finally:
+        svc.shutdown()
+        shutdown_nodes(nodes)
+
+
 def test_service_debug_endpoints():
     """/debug/stacks (thread dump) and /debug/profile (all-thread stack
     sampler) — the profiling channel of the reference's
@@ -136,6 +192,20 @@ def test_service_debug_endpoints():
             prof = r.read().decode()
         assert "hottest frames" in prof
         assert "node.py" in prof, "profile missed the node's own threads"
+
+        # collapsed (folded-stack) output: `frame;frame;... count` lines,
+        # root-first, ready for flamegraph.pl / speedscope
+        with urllib.request.urlopen(
+            base + "/debug/profile?seconds=0.5&format=collapsed", timeout=30
+        ) as r:
+            folded = r.read().decode()
+        lines = [ln for ln in folded.splitlines() if ln]
+        assert lines
+        for ln in lines:
+            stack, count = ln.rsplit(" ", 1)
+            assert int(count) >= 1
+            assert stack
+        assert any(";" in ln for ln in lines), "no multi-frame stacks"
     finally:
         svc.shutdown()
         shutdown_nodes(nodes)
