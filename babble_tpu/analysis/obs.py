@@ -15,10 +15,24 @@ Rules (waiver tag `obs-ok`):
   literal.
 - obs-label-decl  — a declaration whose `labels=` argument is not a
   literal tuple/list of string literals.
+- obs-trace-static-name — a span emission (`*.span/record` on an
+  obs/tracer receiver) whose name argument is not a string literal;
+  span names feed the same catalog/dashboard contract as metric names
+  and the trace-fingerprint determinism contract (docs/sim.md).
+- obs-ctx-in-event — any trace-context vocabulary (trace_id, span_id,
+  TraceContext, a "Traces" wire key, ...) appearing in
+  hashgraph/event.py.  Causal-trace context is piggybacked out-of-band
+  on sync RPC payloads precisely so it can NEVER reach the signed event
+  body: a context field folded into event bytes changes hashes and
+  signatures and breaks wire compatibility with trace-unaware nodes.
+  This rule makes that invariant a build failure instead of a review
+  convention.
 
 Scope: any call `<recv>.counter|gauge|histogram(...)` where the receiver
 chain ends in `obs`, `registry`, `reg` or `metrics` — the conventional
-handles for the per-node Observability bundle and its MetricsRegistry.
+handles for the per-node Observability bundle and its MetricsRegistry —
+and any call `<recv>.span|record(...)` where it ends in `obs` or
+`tracer`.
 """
 
 from __future__ import annotations
@@ -32,6 +46,20 @@ WAIVER = "obs-ok"
 
 DECL_METHODS = {"counter", "gauge", "histogram"}
 RECEIVER_TAILS = {"obs", "registry", "reg", "metrics"}
+
+TRACE_METHODS = {"span", "record"}
+TRACE_RECEIVER_TAILS = {"obs", "tracer"}
+
+# Vocabulary that must never appear in hashgraph/event.py (signed-body
+# construction): identifiers or short key-like strings naming the causal
+# trace context.  Matching is substring over identifiers and over
+# whitespace-free string constants (prose in docstrings stays free to
+# *mention* tracing).
+TRACE_TOKENS = (
+    "trace_id", "span_id", "trace_ctx", "tracectx", "tracecontext",
+    "trace_context", "traces",
+)
+EVENT_FILE_SUFFIX = "hashgraph/event.py"
 
 
 def _is_str_literal(node: ast.AST) -> bool:
@@ -55,6 +83,16 @@ def _decl_receiver(func: ast.Attribute) -> Optional[str]:
     return recv if tail in RECEIVER_TAILS else None
 
 
+def _trace_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a span emission, or None when this is not a
+    tracer call we police (e.g. `writer.record(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in TRACE_RECEIVER_TAILS else None
+
+
 class _ObsVisitor(SymbolTracker):
     def __init__(self, sf: SourceFile) -> None:
         super().__init__()
@@ -76,7 +114,26 @@ class _ObsVisitor(SymbolTracker):
             recv = _decl_receiver(func)
             if recv is not None:
                 self._check_decl(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in TRACE_METHODS:
+            recv = _trace_receiver(func)
+            if recv is not None:
+                self._check_trace(node, recv, func.attr)
         self.generic_visit(node)
+
+    def _check_trace(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-trace-static-name", node,
+                f"{recv}.{method}(...) emits a span with a computed name; "
+                "span names must be static string literals — they feed the "
+                "span catalog and the deterministic cluster-trace "
+                "fingerprint (docs/sim.md), so a runtime-computed name "
+                "breaks both",
+            )
 
     def _check_decl(self, node: ast.Call, recv: str, method: str) -> None:
         name_arg: Optional[ast.AST] = node.args[0] if node.args else None
@@ -105,7 +162,64 @@ class _ObsVisitor(SymbolTracker):
             )
 
 
+def _matches_trace_token(text: str) -> Optional[str]:
+    low = text.lower()
+    for tok in TRACE_TOKENS:
+        if tok in low:
+            return tok
+    return None
+
+
+def _check_ctx_in_event(sf: SourceFile) -> List[Finding]:
+    """Flag trace-context vocabulary anywhere in hashgraph/event.py —
+    the signed-body file must stay structurally unaware of tracing."""
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, what: str, tok: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if sf.has_waiver(line, WAIVER):
+            return
+        findings.append(Finding(
+            rule="obs-ctx-in-event", path=sf.path, line=line,
+            message=f"{what} mentions trace-context token '{tok}' inside "
+                    "hashgraph/event.py; trace context is piggybacked "
+                    "out-of-band on sync payloads and must never reach "
+                    "signed event bytes (it would change event hashes "
+                    "and signatures)",
+        ))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Name):
+            tok = _matches_trace_token(node.id)
+            if tok:
+                emit(node, f"identifier '{node.id}'", tok)
+        elif isinstance(node, ast.Attribute):
+            tok = _matches_trace_token(node.attr)
+            if tok:
+                emit(node, f"attribute '.{node.attr}'", tok)
+        elif isinstance(node, ast.arg):
+            tok = _matches_trace_token(node.arg)
+            if tok:
+                emit(node, f"parameter '{node.arg}'", tok)
+        elif isinstance(node, ast.keyword) and node.arg:
+            tok = _matches_trace_token(node.arg)
+            if tok:
+                emit(node.value, f"keyword '{node.arg}='", tok)
+        elif (isinstance(node, ast.Constant)
+              and isinstance(node.value, str)
+              and not any(c.isspace() for c in node.value)):
+            # whitespace-free strings are key-like (wire/dict keys);
+            # prose in docstrings is free to mention tracing
+            tok = _matches_trace_token(node.value)
+            if tok:
+                emit(node, f"string key '{node.value}'", tok)
+    return findings
+
+
 def check_obs(sf: SourceFile) -> Iterable[Finding]:
     visitor = _ObsVisitor(sf)
     visitor.visit(sf.tree)
-    return visitor.findings
+    findings = list(visitor.findings)
+    if sf.path.replace("\\", "/").endswith(EVENT_FILE_SUFFIX):
+        findings.extend(_check_ctx_in_event(sf))
+    return findings

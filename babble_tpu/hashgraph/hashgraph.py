@@ -444,6 +444,10 @@ class Hashgraph:
         if event.is_loaded():
             self.pending_loaded_events += 1
         self.sig_pool.extend(event.block_signatures())
+        # causal tracing (ISSUE 5): the traced txs this event carries are
+        # now in the graph — the trace store looks them up by tx hash, so
+        # no trace data touches the signed event bytes
+        self.obs.traces.mark_event(event.transactions())
 
     def _set_wire_info(self, event: Event) -> None:
         self_parent_index = -1
@@ -543,6 +547,7 @@ class Hashgraph:
             if ev.round is None:
                 round_number = self.round(hash_)
                 ev.set_round(round_number)
+                self.obs.traces.mark_round(ev.transactions())
                 update_event = True
 
                 try:
@@ -700,6 +705,7 @@ class Hashgraph:
                     received = True
                     ex = self.store.get_event(x)
                     ex.set_round_received(i)
+                    self.obs.traces.mark_famous(ex.transactions())
                     self.store.set_event(ex)
                     tr.set_consensus_event(x)
                     self.store.set_round(i, tr)
@@ -1030,7 +1036,7 @@ class Hashgraph:
             pass_()
             dur = clock.monotonic() - start
             self._pass_hist.labels(phase=phase).observe(dur)
-            self.obs.tracer.record("consensus." + phase, start, dur)
+            self.obs.tracer.record("consensus." + phase, start, dur)  # obs-ok: phases are the literal tuple above
             self.logger.debug("%s() duration=%dns", name, int(dur * 1e9))
 
     # ------------------------------------------------------------------

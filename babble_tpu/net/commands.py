@@ -34,14 +34,23 @@ class SyncResponse:
     sync_limit: bool = False
     events: List[WireEvent] = field(default_factory=list)
     known: Dict[int, int] = field(default_factory=dict)
+    # OUT-OF-BAND causal-trace contexts for the traced transactions the
+    # payload carries (ISSUE 5): an extra optional JSON field, never part
+    # of the signed event bytes — trace-unaware nodes ignore it (their
+    # from_json only reads known keys) and the key is omitted when empty,
+    # so untraced payloads stay byte-identical to the pre-trace wire
+    traces: List[dict] = field(default_factory=list)
 
     def to_json(self) -> dict:
-        return {
+        d = {
             "FromID": self.from_id,
             "SyncLimit": self.sync_limit,
             "Events": [e.to_json() for e in self.events],
             "Known": {str(k): v for k, v in self.known.items()},
         }
+        if self.traces:
+            d["Traces"] = self.traces
+        return d
 
     @classmethod
     def from_json(cls, d: dict) -> "SyncResponse":
@@ -50,6 +59,7 @@ class SyncResponse:
             sync_limit=d.get("SyncLimit", False),
             events=[WireEvent.from_json(e) for e in d.get("Events", [])],
             known={int(k): v for k, v in d.get("Known", {}).items()},
+            traces=d.get("Traces") or [],
         )
 
 
@@ -57,15 +67,21 @@ class SyncResponse:
 class EagerSyncRequest:
     from_id: int
     events: List[WireEvent] = field(default_factory=list)
+    # same out-of-band trace piggyback as SyncResponse (the push leg)
+    traces: List[dict] = field(default_factory=list)
 
     def to_json(self) -> dict:
-        return {"FromID": self.from_id, "Events": [e.to_json() for e in self.events]}
+        d = {"FromID": self.from_id, "Events": [e.to_json() for e in self.events]}
+        if self.traces:
+            d["Traces"] = self.traces
+        return d
 
     @classmethod
     def from_json(cls, d: dict) -> "EagerSyncRequest":
         return cls(
             from_id=d["FromID"],
             events=[WireEvent.from_json(e) for e in d.get("Events", [])],
+            traces=d.get("Traces") or [],
         )
 
 

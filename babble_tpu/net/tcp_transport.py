@@ -231,6 +231,9 @@ class TCPTransport(Transport):
             raise TransportError(f"failed to connect to {target}: {exc}") from exc
         try:
             conn.settimeout(self.timeout)
+            # to_json carries the full message including any out-of-band
+            # `Traces` piggyback (transport.py contract): the frame layer
+            # is deliberately oblivious to trace contexts
             body = json.dumps(req.to_json()).encode()
             if self._m_frame_bytes is not None:
                 self._m_frame_bytes.labels(direction="sent").observe(len(body))

@@ -5,6 +5,13 @@ Mirrors the three-verb contract of the reference transport layer
 EagerSync and FastForward requests to a peer, and exposes a consumer
 queue on which inbound RPCs arrive for the node's background dispatcher.
 Responses travel back on a per-RPC response queue.
+
+Causal-trace piggyback contract (ISSUE 5): SyncResponse and
+EagerSyncRequest may carry an out-of-band `traces` list (wire key
+`Traces`, see commands.py). Transports MUST pass it through opaquely —
+it rides the message's ordinary JSON serialization, is omitted when
+empty, and is never folded into signed event bytes, so trace-aware and
+trace-unaware nodes interoperate on the same wire format.
 """
 
 from __future__ import annotations

@@ -45,6 +45,18 @@ class Config:
     # the simulator passes a per-node random.Random seeded from the run
     # seed so replays reproduce every choice.
     rng: Optional[random.Random] = None
+    # cross-node causal tracing (ISSUE 5): propagate TraceContexts on
+    # gossip payloads and record per-stage spans/histograms. Tracing is
+    # out-of-band by construction (never in signed event bytes), so
+    # flipping it changes no consensus behaviour — only telemetry.
+    tracing: bool = True
+    # LRU cap on live TraceContexts per node (evictions count into
+    # obs_traces_dropped_total)
+    trace_capacity: int = 4096
+    # liveness watchdog (node/watchdog.py): warn + set the
+    # babble_consensus_stalled gauge when round-received has not advanced
+    # for this many Clock seconds despite pending work
+    stall_deadline: float = 10.0
     # minimum seconds between Node.log_stats() snapshot lines — the
     # heartbeat fires every successful gossip exchange, which at test
     # heartbeats would be hundreds of log records a second

@@ -43,6 +43,7 @@ from .control_timer import new_random_control_timer
 from .core import Core
 from .peer_selector import RandomPeerSelector
 from .state import NodeState, NodeStateMachine
+from .watchdog import LivenessWatchdog
 
 
 def _is_benign_race(e: Exception) -> bool:
@@ -96,7 +97,10 @@ class Node(NodeStateMachine):
         # one observability bundle per node: typed metrics registry +
         # span ring, timed by the SAME injected clock as the node loops,
         # so sim runs report deterministic latency histograms
-        self.obs = Observability(clock=conf.clock, node_id=id_)
+        self.obs = Observability(
+            clock=conf.clock, node_id=id_,
+            trace_capacity=conf.trace_capacity, tracing=conf.tracing,
+        )
         self.core = Core(
             id_, key, pmap, store, self.commit_ch, conf.logger,
             consensus_backend=conf.consensus_backend,
@@ -112,6 +116,9 @@ class Node(NodeStateMachine):
         trans.bind_obs(self.obs)
         self.net_ch = trans.consumer()
         self.proxy = proxy
+        # trace submissions at the app-ingress edge: the submit->event
+        # stage then includes the queue wait (ISSUE 5)
+        proxy.bind_obs(self.obs)
         self.submit_ch = proxy.submit_ch()
         self.shutdown_event = threading.Event()
         self.control_timer = new_random_control_timer(
@@ -258,6 +265,20 @@ class Node(NodeStateMachine):
             "Successful live-engine re-attaches",
         ).set_function(lambda: self.core.live_reattaches)
 
+        # liveness watchdog (node/watchdog.py): round-advance stall
+        # detection + per-peer gossip health. Fed by _obs_sync (shared
+        # with the simulator's exchanges) and checked from the heartbeat
+        # tick (threaded _babble loop; SimCluster._tick in the sim).
+        self.watchdog = LivenessWatchdog(
+            clock=self.clock, obs=self.obs, logger=self.logger,
+            deadline=conf.stall_deadline,
+            round_fn=self.core.get_last_consensus_round_index,
+            pending_fn=lambda: (
+                len(self.core.get_undetermined_events())
+                + len(self.core.transaction_pool)
+            ),
+        )
+
         # rate limit for log_stats (satellite: no full dict per heartbeat)
         self._last_stats_log = float("-inf")
 
@@ -359,6 +380,7 @@ class Node(NodeStateMachine):
                 self.control_timer.tick_ch.get(timeout=0.05)
             except queue.Empty:
                 continue
+            self.watchdog.check()
             if gossip:
                 # At most ONE outbound exchange in flight (deliberate
                 # deviation from the reference, node.go:180-196, which
@@ -465,6 +487,9 @@ class Node(NodeStateMachine):
                     diff = self.core.event_diff(cmd.known)
                     exported = self.core.seq
                 resp.events = self.core.to_wire(diff)
+                # piggyback trace contexts for the traced txs the served
+                # diff carries (out-of-band: hash-safe by construction)
+                resp.traces = self.obs.traces.contexts_for(diff)
                 self._m_payload.labels(direction="served").observe(
                     len(resp.events)
                 )
@@ -482,6 +507,10 @@ class Node(NodeStateMachine):
     def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
         success = True
         err: Optional[str] = None
+        # adopt pushed trace contexts before the insert (same rule as
+        # _pull: the consensus hooks must find them)
+        if cmd.traces:
+            self.obs.traces.absorb(cmd.traces)
         with self.core_lock:
             try:
                 self.sync(cmd.events)
@@ -597,6 +626,7 @@ class Node(NodeStateMachine):
             "gossip", start, now - start,
             {"peer": peer_addr, "result": result},
         )
+        self.watchdog.note_sync(peer_addr, result == "ok")
 
     def _gossip_fail(self, peer_addr: str, e: Exception) -> bool:
         """Bookkeeping for a failed exchange. Returns True when the failure
@@ -664,6 +694,10 @@ class Node(NodeStateMachine):
         self._m_payload.labels(direction="pulled").observe(
             len(resp.events or [])
         )
+        # adopt piggybacked trace contexts BEFORE inserting the payload,
+        # so the consensus hooks find them when the events land
+        if resp.traces:
+            self.obs.traces.absorb(resp.traces)
         if resp.events:
             with self.core_lock:
                 self.sync(resp.events)
@@ -686,7 +720,11 @@ class Node(NodeStateMachine):
         self._note_export(exported)
         self._m_payload.labels(direction="pushed").observe(len(wire_events))
         self.trans.eager_sync(
-            peer_addr, EagerSyncRequest(from_id=self.id, events=wire_events)
+            peer_addr,
+            EagerSyncRequest(
+                from_id=self.id, events=wire_events,
+                traces=self.obs.traces.contexts_for(diff),
+            ),
         )
 
     def fast_forward(self) -> None:
@@ -885,6 +923,8 @@ class Node(NodeStateMachine):
             "commit", now, 0.0,
             {"block": block.index(), "txs": len(block.transactions())},
         )
+        # complete (and release) the causal traces this block carried
+        self.obs.traces.mark_commit(block.transactions())
 
     def _add_transaction(self, tx: bytes) -> None:
         tx = bytes(tx)
@@ -893,6 +933,9 @@ class Node(NodeStateMachine):
                 # setdefault: re-submitting identical bytes keeps the
                 # FIRST submit time (latency must not shrink on retries)
                 self._tx_times.setdefault(tx, self.clock.monotonic())
+        # open the causal trace if the proxy hasn't already (bind_obs):
+        # idempotent, keeps the earliest submit mark
+        self.obs.traces.begin(tx)
         with self.core_lock:
             self.core.add_transactions([tx])
 

@@ -1,0 +1,175 @@
+"""Consensus liveness watchdog (ISSUE 5).
+
+Hashgraph liveness is round advance: as long as gossip flows and fame
+gets decided, `last_consensus_round` keeps moving. The watchdog turns
+the two ways that stops into operator-visible signals:
+
+- **round-advance stall** — no round-received progress within a
+  Clock-based deadline while work is pending (undetermined events or a
+  non-empty transaction pool). One warning log per stall episode (and
+  one info on recovery), plus the `babble_consensus_stalled` gauge the
+  whole time, so alerting does not depend on log scraping.
+- **per-peer gossip health** — cumulative sync success rate and the
+  staleness of the last successful sync per peer, as bounded
+  peer-labelled gauges (`babble_peer_health`,
+  `babble_peer_sync_staleness_seconds`). Label cardinality is bounded
+  twice: a local peer cap here, and the registry's MAX_LABEL_SETS
+  overflow collapse behind it.
+
+Everything times through the injected Clock and is fed by hooks shared
+between the threaded node and the simulator (`_obs_sync`, the tick
+loops), so the watchdog behaves identically — and deterministically —
+under `sim`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..common.clock import Clock
+
+# local bound on distinct peers tracked; the metrics registry's
+# MAX_LABEL_SETS overflow is the second line of defence
+MAX_PEERS = 256
+
+
+class _PeerHealth:
+    __slots__ = ("ok", "errors", "last_ok")
+
+    def __init__(self) -> None:
+        self.ok = 0
+        self.errors = 0
+        self.last_ok: Optional[float] = None
+
+
+class LivenessWatchdog:
+    """Round-advance stall detector + per-peer gossip health scores."""
+
+    def __init__(
+        self,
+        clock: Clock,
+        obs,
+        logger: logging.Logger,
+        deadline: float,
+        round_fn: Callable[[], Optional[int]],
+        pending_fn: Callable[[], int],
+    ):
+        self.clock = clock
+        self.logger = logger
+        self.deadline = deadline
+        self._round_fn = round_fn
+        self._pending_fn = pending_fn
+        self._lock = threading.Lock()
+        # guarded-by: _lock — insertion-ordered so eviction is oldest-first
+        self._peers: "OrderedDict[str, _PeerHealth]" = OrderedDict()
+        self._last_round: Optional[int] = None  # guarded-by: _lock
+        self._last_advance = clock.monotonic()  # guarded-by: _lock
+        self._stalled = False  # guarded-by: _lock
+        self._g_stalled = obs.gauge(
+            "babble_consensus_stalled",
+            "1 while round-received has not advanced within the stall "
+            "deadline despite pending work",
+        )
+        self._g_stalled.set(0.0)
+        self._g_health = obs.gauge(
+            "babble_peer_health",
+            "Per-peer gossip sync success rate (successes / attempts)",
+            labels=("peer",),
+        )
+        self._g_staleness = obs.gauge(
+            "babble_peer_sync_staleness_seconds",
+            "Seconds since the last successful sync with the peer "
+            "(since boot if none yet)",
+            labels=("peer",),
+        )
+
+    # ------------------------------------------------------------------
+    # feeds
+    # ------------------------------------------------------------------
+
+    def note_sync(self, peer_addr: str, ok: bool) -> None:
+        """One finished outbound exchange (fed from Node._obs_sync, which
+        both the threaded gossip path and the simulator call)."""
+        now = self.clock.monotonic()
+        with self._lock:
+            ph = self._peers.get(peer_addr)
+            if ph is None:
+                if len(self._peers) >= MAX_PEERS:
+                    self._peers.popitem(last=False)
+                ph = self._peers[peer_addr] = _PeerHealth()
+            if ok:
+                ph.ok += 1
+                ph.last_ok = now
+            else:
+                ph.errors += 1
+
+    # ------------------------------------------------------------------
+    # the periodic check
+    # ------------------------------------------------------------------
+
+    def check(self) -> bool:
+        """Evaluate stall state and refresh the health gauges. Called from
+        the node's heartbeat tick (and the sim's). Returns the current
+        stalled verdict (for tests)."""
+        now = self.clock.monotonic()
+        read_failed = False
+        rnd: Optional[int] = None
+        try:
+            rnd = self._round_fn()
+        except Exception:  # noqa: BLE001 — racing a reset/rebuild: the
+            read_failed = True  # next tick re-reads a settled view
+        recovered = False
+        stalled_now = False
+        with self._lock:
+            if read_failed:
+                rnd = self._last_round
+            if rnd != self._last_round:
+                # ANY change counts as progress — fast-forward can move
+                # the round backwards through a reset, which is still
+                # liveness, not a stall
+                self._last_round = rnd
+                self._last_advance = now
+                if self._stalled:
+                    self._stalled = False
+                    recovered = True
+            elif (
+                not self._stalled
+                and now - self._last_advance > self.deadline
+            ):
+                try:
+                    pending = self._pending_fn()
+                except Exception:  # noqa: BLE001 — same racing-reset rule
+                    pending = 0
+                if pending > 0:
+                    self._stalled = True
+                    stalled_now = True
+            stalled = self._stalled
+            last_round = self._last_round
+            waited = now - self._last_advance
+            # staleness floor for a peer that never synced: the last
+            # round advance, the most recent "known healthy" reference
+            floor = self._last_advance
+            peers = list(self._peers.items())
+        # one-shot logs per episode; the gauge carries the steady state
+        if stalled_now:
+            self.logger.warning(
+                "consensus stalled: no round-received advance in %.1fs "
+                "(deadline %.1fs, last round %s) with pending work",
+                waited, self.deadline, last_round,
+            )
+        elif recovered:
+            self.logger.info(
+                "consensus resumed: round advanced to %s", rnd,
+            )
+        self._g_stalled.set(1.0 if stalled else 0.0)
+        for addr, ph in peers:
+            total = ph.ok + ph.errors
+            self._g_health.labels(peer=addr).set(
+                ph.ok / total if total else 0.0
+            )
+            ref = ph.last_ok if ph.last_ok is not None else floor
+            self._g_staleness.labels(peer=addr).set(max(0.0, now - ref))
+        return stalled

@@ -33,6 +33,14 @@ from .metrics import (
     log_buckets,
 )
 from .trace import DEFAULT_SPAN_CAPACITY, Span, SpanTracer
+from .tracectx import (
+    DEFAULT_TRACE_CAPACITY,
+    TraceContext,
+    TraceStore,
+    assemble_cluster_trace,
+    span_id_for,
+    trace_id_for,
+)
 
 __all__ = [
     "Observability",
@@ -42,24 +50,40 @@ __all__ = [
     "Histogram",
     "SpanTracer",
     "Span",
+    "TraceContext",
+    "TraceStore",
+    "assemble_cluster_trace",
+    "trace_id_for",
+    "span_id_for",
     "log_buckets",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_SIZE_BUCKETS",
     "DEFAULT_COUNT_BUCKETS",
     "DEFAULT_SPAN_CAPACITY",
+    "DEFAULT_TRACE_CAPACITY",
     "MAX_LABEL_SETS",
 ]
 
 
 class Observability:
-    """Per-node bundle of registry + tracer + the clock they time by."""
+    """Per-node bundle of registry + tracer + trace store + the clock
+    they all time by."""
 
     def __init__(self, clock: Optional[Clock] = None, node_id: int = 0,
-                 span_capacity: int = DEFAULT_SPAN_CAPACITY):
+                 span_capacity: int = DEFAULT_SPAN_CAPACITY,
+                 trace_capacity: int = DEFAULT_TRACE_CAPACITY,
+                 tracing: bool = True):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.node_id = node_id
         self.registry = MetricsRegistry()
         self.tracer = SpanTracer(clock=self.clock, capacity=span_capacity)
+        # cross-node causal tracing (ISSUE 5): live TraceContexts for
+        # in-flight transactions, bounded, feeding per-stage histograms
+        # and trace.* spans into the registry/tracer above
+        self.traces = TraceStore(
+            clock=self.clock, node_id=node_id, registry=self.registry,
+            tracer=self.tracer, capacity=trace_capacity, enabled=tracing,
+        )
 
     # Delegates so call sites read `obs.counter("...")`. The name flows
     # through a parameter here, which the obs-dynamic-name rule cannot
@@ -80,4 +104,4 @@ class Observability:
     def span(self, name: str, histogram=None, **attrs):
         """Context manager timing a block into the span ring (and an
         optional histogram) via the injected clock."""
-        return self.tracer.span(name, histogram=histogram, **attrs)
+        return self.tracer.span(name, histogram=histogram, **attrs)  # obs-ok: delegate, name checked at call sites

@@ -80,10 +80,16 @@ class SpanTracer:
             return [s for s in self._ring[head:] + self._ring[:head]
                     if s is not None]
 
-    def to_chrome_trace(self, pid: int = 0) -> dict:
+    def to_chrome_trace(self, pid: int = 0,
+                        trace_id: Optional[str] = None) -> dict:
         """Chrome trace-event JSON: complete ("X") events, µs timestamps,
-        plus thread_name metadata so Perfetto shows real thread names."""
+        plus thread_name metadata so Perfetto shows real thread names.
+        `trace_id` narrows the export to spans carrying that causal-trace
+        id in their attrs (the /debug/trace?trace_id= filter)."""
         spans = self.spans()
+        if trace_id is not None:
+            spans = [sp for sp in spans
+                     if sp.attrs and sp.attrs.get("trace") == trace_id]
         tids: Dict[str, int] = {}
         events: List[dict] = []
         for sp in spans:

@@ -18,7 +18,9 @@ class InmemAppProxy(AppProxy):
 
     def submit_tx(self, tx: bytes) -> None:
         # defensive copy: the caller may mutate its buffer after submit
-        self._submit.put(bytes(tx))
+        tx = bytes(tx)
+        self._trace_submit(tx)
+        self._submit.put(tx)
 
     def submit_ch(self) -> "queue.Queue[bytes]":
         return self._submit

@@ -15,6 +15,23 @@ from ..hashgraph import Block
 
 
 class AppProxy(ABC):
+    # observability bundle bound by the owning Node; None until bound
+    _obs = None
+
+    def bind_obs(self, obs) -> None:
+        """Attach the node's observability bundle so transaction
+        submission can open a causal TraceContext at the app-ingress
+        edge (before queueing) — the submit->event stage then includes
+        the queue wait, which is where a saturated node actually spends
+        the time (ISSUE 5)."""
+        self._obs = obs
+
+    def _trace_submit(self, tx: bytes) -> None:
+        """Open (or touch) the trace for a submitted transaction.
+        Subclasses call this from their submit entry points."""
+        if self._obs is not None:
+            self._obs.traces.begin(tx)
+
     @abstractmethod
     def submit_ch(self) -> "queue.Queue[bytes]":
         """Queue of raw transactions submitted by the app."""

@@ -43,7 +43,9 @@ class SocketAppProxy(AppProxy):
         return self.server.addr
 
     def _handle_submit_tx(self, param) -> bool:
-        self._submit_ch.put(b64d(param))
+        tx = b64d(param)
+        self._trace_submit(tx)
+        self._submit_ch.put(tx)
         return True
 
     # ---- AppProxy interface -------------------------------------------

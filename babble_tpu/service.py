@@ -7,7 +7,12 @@ riding the service mux (reference: cmd/babble/main.go:4):
 - GET /debug/profile?seconds=N — sample every thread's stack for N seconds
   (<=60) and return the hottest frames/stacks as text; add
   `&format=collapsed` for folded-stack output (flamegraph.pl compatible)
-- GET /debug/trace           — recent obs spans as Chrome trace-event JSON
+- GET /debug/trace           — recent obs spans as Chrome trace-event JSON;
+  `?trace_id=<id>` narrows the doc to one causal trace's spans
+- GET /debug/trace/cluster?trace_id=<id>&peers=h1:p1,h2:p2 — federate:
+  fetch each peer's /debug/trace for the same trace id and merge all the
+  docs into a single Chrome-trace timeline (one pid per node), so one
+  transaction can be followed across the whole cluster in Perfetto
 
 and the Prometheus exposition of the node's typed metrics registry:
 
@@ -26,11 +31,13 @@ import logging
 import sys
 import threading
 import traceback
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, quote, urlparse
 
 from .common import Clock, SYSTEM_CLOCK
+from .obs import assemble_cluster_trace
 from .utils.netaddr import split_hostport
 
 
@@ -140,6 +147,46 @@ class Service:
         self._httpd: Optional[ThreadingHTTPServer] = None  # guarded-by: _lifecycle_lock
         self._thread: Optional[threading.Thread] = None  # guarded-by: _lifecycle_lock
 
+    def cluster_trace(
+        self, trace_id: Optional[str], peers: List[str],
+        timeout: float = 2.0,
+    ) -> dict:
+        """Federate one causal trace across the cluster: merge this
+        node's Chrome-trace doc with each peer's `/debug/trace` doc
+        (fetched over their stats/service ports) into a single timeline.
+        A peer that cannot be reached is skipped and reported in the
+        response's `failed_peers` — partial visibility beats a 500 when
+        a node is down (that outage is often what's being diagnosed)."""
+        docs: List[Tuple[Optional[int], dict]] = []
+        obs = getattr(self.node, "obs", None)
+        if obs is not None:
+            docs.append((
+                getattr(self.node, "id", 0),
+                obs.tracer.to_chrome_trace(
+                    pid=getattr(self.node, "id", 0), trace_id=trace_id,
+                ),
+            ))
+        failed: List[str] = []
+        for peer in peers:
+            url = f"http://{peer}/debug/trace"
+            if trace_id:
+                url += f"?trace_id={quote(trace_id)}"
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    doc = json.loads(resp.read().decode())
+                # peer already stamped its own pid on its spans — pass
+                # node_id=None so assembly preserves it
+                docs.append((None, doc))
+            except Exception as e:  # noqa: BLE001 — any peer failure
+                self.logger.debug(
+                    "cluster_trace: peer %s unreachable: %s", peer, e
+                )
+                failed.append(peer)
+        merged = assemble_cluster_trace(docs)
+        merged["trace_id"] = trace_id
+        merged["failed_peers"] = failed
+        return merged
+
     def debug_allowed(self, client_ip: str) -> bool:
         return self.remote_debug or client_ip in (
             "127.0.0.1", "::1", "::ffff:127.0.0.1",
@@ -179,14 +226,27 @@ class Service:
                         if self.path == "/debug/stacks":
                             body = thread_stacks().encode()
                             ctype = "text/plain"
-                        elif self.path == "/debug/trace":
+                        elif self.path.startswith("/debug/trace/cluster"):
+                            q = parse_qs(urlparse(self.path).query)
+                            tid = q.get("trace_id", [None])[0]
+                            peers = [
+                                p for p in
+                                q.get("peers", [""])[0].split(",") if p
+                            ]
+                            body = json.dumps(
+                                service.cluster_trace(tid, peers)
+                            ).encode()
+                        elif urlparse(self.path).path == "/debug/trace":
                             obs = getattr(service.node, "obs", None)
                             if obs is None:
                                 self.send_error(404, "node has no obs tracer")
                                 return
+                            q = parse_qs(urlparse(self.path).query)
+                            tid = q.get("trace_id", [None])[0]
                             body = json.dumps(
                                 obs.tracer.to_chrome_trace(
-                                    pid=getattr(service.node, "id", 0)
+                                    pid=getattr(service.node, "id", 0),
+                                    trace_id=tid,
                                 )
                             ).encode()
                         elif self.path.startswith("/debug/profile"):

@@ -37,11 +37,14 @@ rejoins via fast-forward.
 
 from __future__ import annotations
 
+import json
 import logging
 import queue
 import random
 from hashlib import sha256
 from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import assemble_cluster_trace
 
 from ..crypto import derive_key, pub_key_bytes
 from ..hashgraph import InmemStore
@@ -104,6 +107,8 @@ class SimCluster:
         artifact_dir: str = "docs/artifacts",
         inject_interval: float = 0.05,
         logger: Optional[logging.Logger] = None,
+        tracing: bool = True,
+        stall_deadline: float = 10.0,
     ):
         if store not in ("inmem", "sqlite"):
             raise ValueError("store must be 'inmem' or 'sqlite'")
@@ -121,6 +126,8 @@ class SimCluster:
         self.store_dir = store_dir
         self.logger = logger or logging.getLogger("babble.sim")
         self.inject_interval = inject_interval
+        self.tracing = tracing
+        self.stall_deadline = stall_deadline
 
         self.clock = SimClock()
         self.sched = SimScheduler(self.clock)
@@ -176,6 +183,8 @@ class SimCluster:
             clock=self.clock,
             rng=sn.rng,
             logger=self.logger,
+            tracing=self.tracing,
+            stall_deadline=self.stall_deadline,
         )
         if self.store_kind == "sqlite":
             node_store = SQLiteStore(
@@ -245,6 +254,10 @@ class SimCluster:
             return
         node = sn.node
         self._drain(sn)
+        # the threaded _babble loop runs the watchdog on every heartbeat
+        # tick; mirror that here so stall detection is part of the
+        # deterministic replay (gauge values ride virtual time)
+        node.watchdog.check()
         state = node.get_state()
         extra = 0.0
         if state == NodeState.CATCHING_UP:
@@ -304,6 +317,10 @@ class SimCluster:
             # locally (stale heads, missing parents) exactly like the
             # threaded path's try block around _pull/_push
             try:
+                # adopt piggybacked trace contexts before the insert,
+                # exactly like the threaded _pull
+                if resp.traces:
+                    node.obs.traces.absorb(resp.traces)
                 if resp.events:
                     with node.core_lock:
                         node.sync(resp.events)
@@ -327,7 +344,10 @@ class SimCluster:
             node._note_export(exported)
             self.net.send(
                 sn.addr, peer_addr,
-                EagerSyncRequest(from_id=node.id, events=wire_events),
+                EagerSyncRequest(
+                    from_id=node.id, events=wire_events,
+                    traces=node.obs.traces.contexts_for(diff),
+                ),
                 on_ok=on_push_ok, on_fail=finish_fail,
                 label=f"{sn.name}:push",
             )
@@ -510,6 +530,8 @@ class SimCluster:
             "ff_attempts": sum(sn.ff_attempts for sn in self.sns),
             "net": dict(self.net.stats),
             "commit_latency": self.latency_histograms(),
+            "stage_latency": self.stage_histograms(),
+            "trace_fingerprint": self.trace_fingerprint(),
             "digest": self.digest(),
         }
 
@@ -525,6 +547,55 @@ class SimCluster:
             snap = sn.node.obs.registry.snapshot()
             out[sn.name] = snap.get("babble_commit_latency_seconds")
         return out
+
+    STAGE_HISTOGRAMS = (
+        "babble_trace_stage_submit_to_event_seconds",
+        "babble_trace_stage_event_to_round_seconds",
+        "babble_trace_stage_round_to_famous_seconds",
+        "babble_trace_stage_famous_to_commit_seconds",
+    )
+
+    def stage_histograms(self) -> Dict[str, Any]:
+        """Per-live-node snapshots of the causal-trace stage histograms
+        (submit->event, event->round, round->famous, famous->commit).
+        Measured on virtual time: part of the determinism contract, like
+        commit_latency."""
+        out: Dict[str, Any] = {}
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            snap = sn.node.obs.registry.snapshot()
+            out[sn.name] = {k: snap.get(k) for k in self.STAGE_HISTOGRAMS}
+        return out
+
+    def cluster_trace(self, trace_id: Optional[str] = None) -> dict:
+        """Assemble the cross-node Chrome-trace timeline from every live
+        node's span ring — the sim-side twin of the HTTP
+        `/debug/trace/cluster` federation, built from virtual time.
+        Unresolvable parent spans (crashed nodes, ring wrap) are cleanly
+        truncated by the assembler: no orphan parent span ids."""
+        docs = [
+            (sn.node.id,
+             sn.node.obs.tracer.to_chrome_trace(pid=sn.node.id,
+                                                trace_id=trace_id))
+            for sn in self.sns
+            if not sn.crashed
+        ]
+        return assemble_cluster_trace(docs)
+
+    def trace_fingerprint(self) -> str:
+        """SHA-256 over the canonical JSON of every causal-trace span in
+        the assembled cluster trace — two runs of the same seed+plan must
+        produce byte-identical fingerprints (the tracing counterpart of
+        digest())."""
+        doc = self.cluster_trace()
+        events = [
+            ev for ev in doc["traceEvents"]
+            if isinstance(ev.get("args"), dict) and ev["args"].get("trace")
+        ]
+        return sha256(
+            json.dumps(events, sort_keys=True).encode()
+        ).hexdigest()
 
     def digest(self) -> str:
         """SHA-256 over every settled block body on every live node, in

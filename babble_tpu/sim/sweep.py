@@ -30,6 +30,8 @@ def run_one(
     artifact_dir: str = "docs/artifacts",
     store_dir: Optional[str] = None,
     heartbeat: float = 0.05,
+    tracing: bool = True,
+    stall_deadline: float = 10.0,
 ) -> Dict[str, Any]:
     """One seeded run. Returns the cluster's result dict plus `ok` /
     `error` / `artifact` fields; never raises on divergence."""
@@ -48,6 +50,8 @@ def run_one(
         store_dir=store_dir,
         artifact_dir=artifact_dir,
         heartbeat=heartbeat,
+        tracing=tracing,
+        stall_deadline=stall_deadline,
     )
     try:
         res = cluster.run(until=until, target_block=target_block)
@@ -76,6 +80,7 @@ def run_sweep(
     target_block: Optional[int] = None,
     artifact_dir: str = "docs/artifacts",
     heartbeat: float = 0.05,
+    tracing: bool = True,
     progress=None,
 ) -> Dict[str, Any]:
     """Run every seed; aggregate. `progress` (optional callable) receives
@@ -93,6 +98,7 @@ def run_sweep(
             target_block=target_block,
             artifact_dir=artifact_dir,
             heartbeat=heartbeat,
+            tracing=tracing,
         )
         rows.append(row)
         if progress is not None:

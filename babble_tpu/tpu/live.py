@@ -534,7 +534,12 @@ class LiveDeviceEngine:
                     self.hg.super_majority, self.n, e_win=self.e_win, r_win=self.r_win,
                 )
                 self.dispatches += 1
-        self._m_dispatch.observe(clock.monotonic() - t0)
+        dt = clock.monotonic() - t0
+        self._m_dispatch.observe(dt)
+        self.hg.obs.tracer.record(
+            "device.dispatch", t0, dt,
+            {"node": self.hg.obs.node_id, "batches": len(built)},
+        )
         return new_rows
 
     def _empty_batch(self) -> Batch:
@@ -834,6 +839,9 @@ def _run_sync(hg, eng: LiveDeviceEngine, new_rows: List[int]) -> None:
     packed = jax.device_get(packed_dev)
     dt = clock.monotonic() - t0
     eng._m_fetch.observe(dt)
+    hg.obs.tracer.record(
+        "device.fetch", t0, dt, {"node": hg.obs.node_id},
+    )
     eng.consensus_calls += 1
 
     last_round_rel = _integrate(hg, eng, packed, snap)
@@ -863,7 +871,11 @@ def _run_pipelined(hg, eng: LiveDeviceEngine) -> None:
         eng.inflight = None
         t0 = clock.monotonic()
         packed = fetch.result()  # normally already resident
-        eng._m_fetch.observe(clock.monotonic() - t0)
+        dt = clock.monotonic() - t0
+        eng._m_fetch.observe(dt)
+        hg.obs.tracer.record(
+            "device.fetch", t0, dt, {"node": hg.obs.node_id},
+        )
         eng.consensus_calls += 1
         last_round_rel = _integrate(hg, eng, packed, snap)
         # capacity BEFORE the next dispatch: a rebase must never run with

@@ -8,10 +8,11 @@ The timed path is the round-frontier pipeline (babble_tpu/tpu/frontier.py);
 its results are asserted bit-equal to the level-scan engine path
 (run_passes) before the number is reported.
 
-Prints a metrics-registry snapshot line first (the obs-layer view of the
-run: per-iteration latency histogram + throughput gauge), then the
-headline as the LAST line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints the headline as the LAST line, carrying the metrics-registry
+snapshot (the obs-layer view of the run: per-iteration latency
+histogram + throughput gauge) inline under its "metrics" key:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "metrics": {...}}
 vs_baseline is value / 1e6 (the BASELINE.json target, since the reference
 publishes no numbers of its own). Drivers that parse the last stdout
 line keep working unchanged.
@@ -177,8 +178,8 @@ def main():
 
     events_per_sec = grid.e / elapsed
 
-    # obs-layer snapshot BEFORE the headline: the driver parses the last
-    # stdout line, so the headline must stay last
+    # obs-layer registry view of the run, embedded in the headline (the
+    # driver parses the last stdout line, so everything rides in it)
     from babble_tpu.obs import Observability, log_buckets
 
     obs = Observability()
@@ -192,9 +193,6 @@ def main():
         "babble_bench_events_per_second",
         "Benchmark throughput headline",
     ).set(events_per_sec)
-    print(json.dumps(
-        {"metrics_snapshot": obs.registry.snapshot()}, sort_keys=True
-    ))
 
     print(
         json.dumps(
@@ -208,6 +206,7 @@ def main():
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+                "metrics": obs.registry.snapshot(),
             }
         )
     )

@@ -40,6 +40,9 @@ def main():
         bombard_and_wait(nodes, proxies, target_block=TARGET_BLOCKS, timeout_s=120)
         elapsed = time.perf_counter() - t0
         check_gossip(nodes, upto=TARGET_BLOCKS)
+        # node 0's typed-registry view of the same run (sync/commit
+        # latencies, trace stage histograms, ...) rides in the headline
+        metrics = nodes[0].obs.registry.snapshot()
     finally:
         shutdown_nodes(nodes)
 
@@ -54,6 +57,7 @@ def main():
                 "unit": "s",
                 # <1 means faster than the reference's CI floor
                 "vs_baseline": round(elapsed / REFERENCE_FLOOR_S, 3),
+                "metrics": metrics,
             }
         )
     )

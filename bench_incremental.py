@@ -138,6 +138,20 @@ def main():
     np.testing.assert_array_equal(np.asarray(state.received)[:e], ref.received)
     assert int(state.last_round) == ref.last_round
 
+    # obs-layer registry view of the run, embedded in the headline
+    from babble_tpu.obs import Observability, log_buckets
+
+    obs = Observability()
+    obs.histogram(
+        "babble_bench_iteration_seconds",
+        "Per-train wall time of the append-mode benchmark",
+        buckets=log_buckets(0.0001, 2.0, 20),
+    ).observe(elapsed / max(len(trains), 1))
+    obs.gauge(
+        "babble_bench_events_per_second",
+        "Benchmark throughput headline",
+    ).set(events_per_sec)
+
     print(
         json.dumps(
             {
@@ -149,6 +163,7 @@ def main():
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / TARGET, 3),
+                "metrics": obs.registry.snapshot(),
             }
         )
     )

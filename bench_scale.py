@@ -221,6 +221,21 @@ def main():
     np.testing.assert_array_equal(np.asarray(out.received), res.received)
 
     events_per_sec = grid.e / elapsed
+
+    # obs-layer registry view of the run, embedded in the headline
+    from babble_tpu.obs import Observability, log_buckets
+
+    obs = Observability()
+    obs.histogram(
+        "babble_bench_iteration_seconds",
+        "Per-iteration wall time of the frontier pipeline at scale",
+        buckets=log_buckets(0.0001, 2.0, 20),
+    ).observe(elapsed)
+    obs.gauge(
+        "babble_bench_events_per_second",
+        "Benchmark throughput headline",
+    ).set(events_per_sec)
+
     print(
         json.dumps(
             {
@@ -232,6 +247,7 @@ def main():
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / 1_000_000.0, 3),
+                "metrics": obs.registry.snapshot(),
             }
         )
     )

@@ -382,6 +382,48 @@ def test_obs_ignores_foreign_receivers(tmp_path):
     assert [f for f in found if f.rule.startswith("obs-")] == []
 
 
+def test_obs_trace_static_name_rule(tmp_path):
+    # span emissions on obs/tracer receivers need literal names; a
+    # reasoned waiver suppresses, foreign receivers are not ours
+    found = _findings(
+        tmp_path, "babble_tpu/node/fixture.py", """\
+        def emit(obs, tracer, phase, writer):
+            obs.tracer.record("consensus." + phase, 0.0, 1.0)
+            tracer.record("gossip", 0.0, 1.0)
+            with obs.span(f"dyn.{phase}"):
+                pass
+            tracer.record("x." + phase, 0.0, 1.0)  # obs-ok: phases are a literal enum
+            writer.record(phase, 0.0, 1.0)
+        """,
+    )
+    assert sorted((f.rule, f.line) for f in found) == [
+        ("obs-trace-static-name", 2),
+        ("obs-trace-static-name", 4),
+    ]
+    assert "static string literals" in found[0].message
+
+
+def test_obs_ctx_in_event_rule(tmp_path):
+    # trace vocabulary in hashgraph/event.py is a finding (identifiers,
+    # parameters, key-like strings); prose docstrings stay free to
+    # mention tracing, and the same code elsewhere is not flagged
+    src = """\
+        '''Signed bodies never carry causal traces - prose is fine.'''
+        def marshal(self, trace_id):
+            body = {"Traces": trace_id}
+            return body
+        """
+    found = _findings(tmp_path, "babble_tpu/hashgraph/event.py", src)
+    ctx = [f for f in found if f.rule == "obs-ctx-in-event"]
+    assert {f.line for f in ctx} == {2, 3}
+    assert any("trace_id" in f.message for f in ctx)
+    assert any("Traces" in f.message for f in ctx)
+
+    other = tmp_path / "elsewhere"
+    found2 = _findings(other, "babble_tpu/node/fixture.py", src)
+    assert [f for f in found2 if f.rule == "obs-ctx-in-event"] == []
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------

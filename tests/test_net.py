@@ -163,3 +163,24 @@ def test_tcp_dial_refused():
             client.sync("127.0.0.1:1", SyncRequest(from_id=0, known={}))
     finally:
         client.close()
+
+
+def test_trace_piggyback_wire_round_trip():
+    """The out-of-band Traces field: present when carried, omitted when
+    empty (byte-identical wire for trace-free payloads), and ignored by
+    from_json when absent (a trace-unaware peer's payload parses)."""
+    ctxs = [{"Id": "ab12", "Origin": 2, "Span": "cd34"}]
+    for cls, kwargs in (
+        (SyncResponse, {"from_id": 1, "known": {0: 5}}),
+        (EagerSyncRequest, {"from_id": 1, "events": sample_wire_events()}),
+    ):
+        carried = cls(traces=ctxs, **kwargs)
+        d = carried.to_json()
+        assert d["Traces"] == ctxs
+        assert cls.from_json(d).traces == ctxs
+
+        bare = cls(**kwargs)
+        d_bare = bare.to_json()
+        assert "Traces" not in d_bare  # untraced payloads keep the old wire
+        # a legacy payload with no Traces key parses to an empty list
+        assert cls.from_json(d_bare).traces == []

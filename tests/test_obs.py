@@ -202,3 +202,230 @@ def test_sim_commit_latency_histogram_deterministic():
         json.dumps(a["commit_latency"], sort_keys=True)
         == json.dumps(b["commit_latency"], sort_keys=True)
     )
+
+
+# ----------------------------------------------------------------------
+# cross-node causal tracing (ISSUE 5): TraceStore lifecycle, bounded
+# memory, wire absorption, filtered export, cluster assembly, watchdog
+# ----------------------------------------------------------------------
+
+import logging
+import urllib.request
+
+from babble_tpu.obs import (
+    TraceStore,
+    assemble_cluster_trace,
+    span_id_for,
+    trace_id_for,
+)
+from babble_tpu.node.watchdog import LivenessWatchdog
+from babble_tpu.service import Service
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+class _Ev:
+    """Minimal stand-in for a hashgraph event: just its payload."""
+
+    def __init__(self, *txs):
+        self._txs = list(txs)
+
+    def transactions(self):
+        return self._txs
+
+
+def _stage_count(obs, name):
+    snap = obs.registry.snapshot()
+    return snap[name]["series"][""]["count"]
+
+
+def test_trace_store_stage_flow_and_completion():
+    clock = SimClock()
+    obs = Observability(clock=clock, node_id=7)
+    st = obs.traces
+    tx = b"tx-bytes"
+    tid = trace_id_for(tx)
+
+    st.begin(tx)
+    st.begin(tx)  # idempotent re-submit
+    assert len(st) == 1
+    ctx = st.get(tid)
+    assert ctx.span_id == span_id_for(tid, 7)
+    assert ctx.parent == "" and ctx.origin == 7
+
+    clock.advance_to(1.0)
+    st.mark_event([tx])
+    st.mark_event([tx])  # idempotent per stage
+    assert _stage_count(obs, "babble_trace_stage_submit_to_event_seconds") == 1
+    clock.advance_to(1.5)
+    st.mark_round([tx])
+    clock.advance_to(2.0)
+    st.mark_famous([tx])
+    clock.advance_to(3.0)
+    st.mark_commit([tx])
+    # commit completes and removes the context — not a drop
+    assert len(st) == 0 and st.get(tid) is None
+    snap = obs.registry.snapshot()
+    assert snap["obs_traces_dropped_total"]["series"].get("", 0.0) == 0.0
+    assert snap["babble_trace_stage_famous_to_commit_seconds"]["series"][""]["sum"] == pytest.approx(1.0)
+    # post-commit relays carry nothing (clean truncation downstream)
+    assert st.contexts_for([_Ev(tx)]) == []
+    # every stage span is tagged with the trace and chains to the base span
+    spans = [s for s in obs.tracer.spans() if s.attrs and s.attrs.get("trace") == tid]
+    assert [s.name for s in spans] == [
+        "trace.submit", "trace.event", "trace.round",
+        "trace.famous", "trace.commit",
+    ]
+    assert all(s.attrs["parent"] == ctx.span_id for s in spans if ":" in s.attrs["span"])
+
+
+def test_trace_store_absorb_and_piggyback():
+    clock = SimClock()
+    sender = Observability(clock=clock, node_id=0)
+    receiver = Observability(clock=clock, node_id=1)
+    tx = b"cross-node"
+    tid = trace_id_for(tx)
+    sender.traces.begin(tx)
+
+    wire = sender.traces.contexts_for([_Ev(tx, b"untraced-tx")])
+    assert wire == [{"Id": tid, "Origin": 0, "Span": span_id_for(tid, 0)}]
+
+    clock.advance_to(0.5)
+    receiver.traces.absorb(wire)
+    receiver.traces.absorb(wire)  # duplicate delivery is harmless
+    ctx = receiver.traces.get(tid)
+    assert ctx.parent == span_id_for(tid, 0)  # the cross-node causal edge
+    assert ctx.span_id == span_id_for(tid, 1)
+    assert ctx.marks == {"receive": 0.5}
+    # malformed piggyback entries are ignored, not fatal
+    receiver.traces.absorb([{"bogus": 1}, "junk", {"Id": ""}])
+    assert len(receiver.traces) == 1
+
+
+def test_trace_store_lru_bound_and_disabled_mode():
+    clock = SimClock()
+    obs = Observability(clock=clock, node_id=0, trace_capacity=2)
+    st = obs.traces
+    for i in range(4):
+        st.begin(b"tx%d" % i)
+    assert len(st) == 2
+    snap = obs.registry.snapshot()
+    assert snap["obs_traces_dropped_total"]["series"][""] == 2.0
+    assert snap["obs_traces_live"]["series"][""] == 2.0
+    # eviction is LRU: the two newest survive
+    assert st.get(trace_id_for(b"tx3")) is not None
+    assert st.get(trace_id_for(b"tx0")) is None
+
+    off = Observability(clock=clock, node_id=0, tracing=False)
+    off.traces.begin(b"tx")
+    off.traces.absorb([{"Id": "ab", "Origin": 0, "Span": "cd"}])
+    assert len(off.traces) == 0
+    assert off.traces.contexts_for([_Ev(b"tx")]) == []
+
+
+def test_chrome_trace_trace_id_filter():
+    tracer = SpanTracer(capacity=8)
+    tracer.record("trace.event", 1.0, 0.5, {"trace": "t1", "span": "a"})
+    tracer.record("trace.event", 2.0, 0.5, {"trace": "t2", "span": "b"})
+    tracer.record("gossip", 3.0, 0.5)
+    doc = tracer.to_chrome_trace(pid=0, trace_id="t1")
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["args"]["trace"] for e in spans] == ["t1"]
+
+
+def test_assemble_cluster_trace_reroots_unresolved_parents():
+    doc_a = {"traceEvents": [
+        {"ph": "X", "name": "trace.submit", "pid": 0, "ts": 0, "dur": 0,
+         "args": {"trace": "t", "span": "s0", "parent": ""}},
+    ]}
+    doc_b = {"traceEvents": [
+        {"ph": "X", "name": "trace.receive", "pid": 9, "ts": 1, "dur": 0,
+         "args": {"trace": "t", "span": "s1", "parent": "s0"}},
+        {"ph": "X", "name": "trace.receive", "pid": 9, "ts": 2, "dur": 0,
+         "args": {"trace": "t", "span": "s2", "parent": "gone"}},
+    ]}
+    merged = assemble_cluster_trace([(0, doc_a), (3, doc_b)])
+    evs = merged["traceEvents"]
+    assert [e["pid"] for e in evs] == [0, 3, 3]  # sim path re-stamps pids
+    by_span = {e["args"]["span"]: e["args"] for e in evs}
+    assert by_span["s1"]["parent"] == "s0"  # resolvable edge kept
+    assert by_span["s2"]["parent"] == "" and by_span["s2"]["truncated"]
+    # the source documents were not mutated
+    assert doc_b["traceEvents"][1]["args"]["parent"] == "gone"
+    # None keeps the exporter's pid (the HTTP federation path)
+    kept = assemble_cluster_trace([(None, doc_b)])
+    assert [e["pid"] for e in kept["traceEvents"]] == [9, 9]
+
+
+def test_watchdog_peer_labels_ride_registry_overflow():
+    clock = SimClock()
+    obs = Observability(clock=clock, node_id=0)
+    wd = LivenessWatchdog(
+        clock=clock, obs=obs, logger=logging.getLogger("test.wd"),
+        deadline=5.0, round_fn=lambda: 1, pending_fn=lambda: 0,
+    )
+    for i in range(MAX_LABEL_SETS + 10):
+        wd.note_sync(f"10.0.0.{i}:1337", ok=True)
+    wd.check()
+    snap = obs.registry.snapshot()
+    for name in ("babble_peer_health", "babble_peer_sync_staleness_seconds"):
+        series = snap[name]["series"]
+        # novel peers past the cap collapse into the "other" series
+        assert len(series) == MAX_LABEL_SETS + 1
+        assert "other" in series
+    assert snap["babble_peer_health"]["series"]["10.0.0.0:1337"] == 1.0
+
+
+class _FakeNode:
+    def __init__(self, node_id, obs):
+        self.id = node_id
+        self.obs = obs
+
+    def get_stats(self):
+        return {"id": str(self.id)}
+
+
+def test_service_trace_filter_and_cluster_federation():
+    tid = "ab" * 8
+    obs0 = Observability(node_id=0)
+    obs1 = Observability(node_id=1)
+    s0 = span_id_for(tid, 0)
+    s1 = span_id_for(tid, 1)
+    obs0.tracer.record("trace.submit", 0.0, 0.0,
+                       {"trace": tid, "span": s0, "parent": "", "node": 0})
+    obs0.tracer.record("gossip", 0.0, 1.0)  # untraced noise
+    obs1.tracer.record("trace.receive", 1.0, 0.0,
+                       {"trace": tid, "span": s1, "parent": s0, "node": 1})
+    obs1.tracer.record("trace.event", 1.0, 0.5,
+                       {"trace": "ffff", "span": "x", "parent": ""})
+
+    svc0 = Service("127.0.0.1:0", _FakeNode(0, obs0))
+    svc1 = Service("127.0.0.1:0", _FakeNode(1, obs1))
+    try:
+        svc0.serve()
+        svc1.serve()
+        base = f"http://{svc0.local_addr()}"
+
+        doc = _get(f"{base}/debug/trace?trace_id={tid}")
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in spans] == ["trace.submit"]
+
+        url = (f"{base}/debug/trace/cluster?trace_id={tid}"
+               f"&peers={svc1.local_addr()},127.0.0.1:1")
+        merged = _get(url)
+        spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        assert sorted(e["name"] for e in spans) == [
+            "trace.receive", "trace.submit",
+        ]
+        assert {e["pid"] for e in spans} == {0, 1}
+        # the cross-node parent edge survived federation
+        recv = next(e for e in spans if e["name"] == "trace.receive")
+        assert recv["args"]["parent"] == s0
+        assert merged["failed_peers"] == ["127.0.0.1:1"]
+        assert merged["trace_id"] == tid
+    finally:
+        svc0.shutdown()
+        svc1.shutdown()

@@ -278,3 +278,107 @@ def test_cli_sim_plan_file(capsys, tmp_path):
     out = json.loads(capsys.readouterr().out)
     assert out["ok"] is True
     assert out["plan"] == "lossy"
+
+
+# ----------------------------------------------------------------------
+# cross-node causal tracing (ISSUE 5): fingerprint determinism, the
+# hash-safety differential, fault-plan trace completeness, watchdog
+# ----------------------------------------------------------------------
+
+def test_trace_fingerprint_deterministic():
+    """Same seed+plan => byte-identical cross-node trace fingerprints and
+    stage-latency histogram snapshots: tracing is part of the determinism
+    contract, not an exception to it."""
+    a = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    b = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    assert a["ok"] and b["ok"]
+    assert a["trace_fingerprint"] == b["trace_fingerprint"]
+    assert (
+        json.dumps(a["stage_latency"], sort_keys=True)
+        == json.dumps(b["stage_latency"], sort_keys=True)
+    )
+    # the fingerprint covers real spans and the stage histograms measured
+    # every stage on every node
+    counts = [
+        snap[name]["series"][""]["count"]
+        for snap in a["stage_latency"].values()
+        for name in SimCluster.STAGE_HISTOGRAMS
+    ]
+    assert counts and all(c > 0 for c in counts)
+
+
+def test_tracing_is_hash_safe_differential():
+    """Tracing on vs off must not change what the cluster commits: trace
+    context never reaches signed event bytes, so the block digest — the
+    replay fingerprint over every committed body — is identical."""
+    traced = run_one(7, plan="clean", n=4, until=None, target_block=3)
+    untraced = run_one(7, plan="clean", n=4, until=None, target_block=3,
+                       tracing=False)
+    assert traced["ok"] and untraced["ok"]
+    assert traced["digest"] == untraced["digest"]
+    assert traced["events_run"] == untraced["events_run"]
+    assert traced["virtual_time"] == untraced["virtual_time"]
+    # and tracing was actually on in the traced run
+    assert traced["trace_fingerprint"] != untraced["trace_fingerprint"]
+
+
+@pytest.mark.parametrize("preset", ["lossy", "partition_heal", "crash_restart"])
+def test_traces_complete_or_cleanly_truncated_under_faults(preset):
+    """Under drop/dup/partition/crash faults every assembled cluster
+    trace is complete or cleanly truncated: no span references a parent
+    span id that is missing from the merged document, and the per-node
+    stores stay within their capacity bound."""
+    cluster = SimCluster(n=4, seed=7, plan=preset_plan(preset, 4))
+    try:
+        cluster.run(until=12.0)
+        doc = cluster.cluster_trace()
+        evs = [e for e in doc["traceEvents"]
+               if e.get("args", {}).get("trace")]
+        assert evs  # faults thin the traces but cannot erase them all
+        span_ids = {e["args"]["span"] for e in evs}
+        orphans = [e for e in evs
+                   if e["args"].get("parent")
+                   and e["args"]["parent"] not in span_ids]
+        assert orphans == []
+        for sn in cluster.sns:
+            assert len(sn.node.obs.traces) <= sn.node.obs.traces.capacity
+    finally:
+        cluster.shutdown()
+
+
+def test_watchdog_trips_on_injected_stall():
+    """A full four-way partition freezes round advance on every node; the
+    watchdog must raise babble_consensus_stalled within one deadline of
+    virtual time (stall begins ~t=1, deadline 2s, asserted at t=8)."""
+    plan = FaultPlan(
+        name="total_partition",
+        partitions=(
+            Partition(start=1.0, end=99.0,
+                      groups=((0,), (1,), (2,), (3,))),
+        ),
+    )
+    cluster = SimCluster(n=4, seed=3, plan=plan, stall_deadline=2.0)
+    try:
+        cluster.run(until=8.0)
+        for sn in cluster.sns:
+            snap = sn.node.obs.registry.snapshot()
+            assert snap["babble_consensus_stalled"]["series"][""] == 1.0
+            # peer gauges were populated from the sync feed, with labels
+            health = snap["babble_peer_health"]["series"]
+            assert health and all(0.0 <= v <= 1.0 for v in health.values())
+    finally:
+        cluster.shutdown()
+
+
+def test_watchdog_quiet_on_healthy_run():
+    """Rounds keep advancing on a clean plan — the stall gauge must sit
+    at 0 even with a deadline short enough to be trippable."""
+    cluster = SimCluster(n=4, seed=5, plan=preset_plan("clean", 4),
+                         stall_deadline=2.0)
+    try:
+        cluster.run(until=12.0)
+        for sn in cluster.sns:
+            snap = sn.node.obs.registry.snapshot()
+            assert snap["babble_consensus_stalled"]["series"][""] == 0.0
+    finally:
+        cluster.shutdown()
