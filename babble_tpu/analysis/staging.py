@@ -7,6 +7,14 @@ babble_tpu/tpu/:
 - decorated:  `@jax.jit` or `@functools.partial(jax.jit, ...)`
 - wrapped:    `g = jax.jit(f)` / `g = functools.partial(jax.jit, ...)(f)`
   at module level, where `f` is a module function.
+- shard_mapped: `shard_map(f, mesh=..., in_specs=..., out_specs=...)`
+  anywhere in the module (tpu/sharded.py builds these inside cached
+  factory functions), where `f` is a module or nested function. A
+  shard_mapped function is traced exactly like a jitted one — and it is
+  the per-shard device code of the queued mesh dispatch path
+  (tpu/dispatch.py), where a stray host sync would serialize the whole
+  async pipeline — so every parameter is audited as a tracer (shard_map
+  has no static_argnames channel).
 
 `static_argnames` are honored: branching on a static argument is
 concretized at trace time and is fine.
@@ -51,6 +59,13 @@ FLOAT_DTYPES = {
 }
 HOST_SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array",
                    "numpy.asarray", "numpy.array", "onp.asarray"}
+
+# spellings of shard_map at its call sites (tpu/sharded.py aliases the
+# experimental import and wraps it in a local compat shim)
+SHARD_MAP_CALLEES = {
+    "shard_map", "_shard_map", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map", "_exp_shard_map",
+}
 
 
 def _is_jit_expr(node: ast.AST) -> Tuple[bool, Tuple[str, ...]]:
@@ -114,6 +129,19 @@ def find_staged_functions(
             target = dotted_name(arg)
             if target in defs and target not in staged:
                 staged[target] = (defs[target], statics)
+    # shard_mapped forms: shard_map(f, mesh=..., ...) ANYWHERE in the
+    # module (the sharded backend builds them inside lru_cached factory
+    # functions, so module-level assignment scanning never sees them).
+    # Only the first positional argument is the staged function; every
+    # parameter is a tracer (no static_argnames channel).
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if dotted_name(node.func) not in SHARD_MAP_CALLEES:
+            continue
+        target = dotted_name(node.args[0])
+        if target in defs and target not in staged:
+            staged[target] = (defs[target], ())
     return staged
 
 

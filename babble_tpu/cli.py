@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--mesh-devices", type=int, default=0,
                      help="With --consensus-backend=tpu: shard the device "
                           "passes over this many chips (0 = single device)")
+    run.add_argument("--dispatch-queue-depth", type=int, default=4,
+                     help="Max device dispatches in flight in the async "
+                          "dispatch queue (1 = single-slot overlap, 0 = "
+                          "disable the queued-mesh rung)")
+    run.add_argument("--dispatch-batch-deadline", type=float, default=0.0,
+                     help="Hold gossip-staged rows up to this many seconds "
+                          "(or until a size threshold) before dispatching, "
+                          "batching device work across syncs (0 = no hold)")
     run.add_argument("--metrics", action="store_true",
                      help="Log periodic metrics-registry snapshots at info "
                           "(the registry always serves GET /metrics on the "
@@ -179,6 +187,8 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "cache-size": "cache_size", "heartbeat": "heartbeat",
         "sync-limit": "sync_limit", "consensus-backend": "consensus_backend",
         "mesh-devices": "mesh_devices", "metrics": "metrics",
+        "dispatch-queue-depth": "dispatch_queue_depth",
+        "dispatch-batch-deadline": "dispatch_batch_deadline",
     }
     for file_key, attr in mapping.items():
         if file_key in cfg and attr not in explicit:
@@ -218,6 +228,8 @@ def run_command(args: argparse.Namespace) -> int:
             sync_limit=args.sync_limit,
             consensus_backend=args.consensus_backend,
             mesh_devices=args.mesh_devices,
+            dispatch_queue_depth=args.dispatch_queue_depth,
+            dispatch_batch_deadline=args.dispatch_batch_deadline,
             metrics_log=args.metrics,
             logger=logger,
         ),

@@ -35,6 +35,18 @@ class Config:
     # ppermute ring shifts, events/chains-sharded tables); any state it
     # cannot express falls down the same ladder as the single-device path
     mesh_devices: int = 0
+    # async device dispatch (tpu/live.py multi-slot pipeline and the
+    # queued-mesh rung in tpu/dispatch.py): up to this many dispatches may
+    # be in flight before the serve path blocks to integrate the oldest.
+    # 1 reproduces the old single-slot overlap; 0 disables queuing.
+    dispatch_queue_depth: int = 4
+    # cross-round dispatch batching: hold gossip-staged rows for up to
+    # this many Clock seconds (or until a size threshold) before
+    # dispatching, so the frontier walk amortizes across syncs. 0.0 =
+    # dispatch every call (no hold). Deadlines are measured on the
+    # injected Clock below — never wallclock — so the deterministic
+    # simulator replays the same batching decisions.
+    dispatch_batch_deadline: float = 0.0
     # time-source seam: every monotonic read and sleep in the node layer
     # goes through this Clock, so the deterministic simulator
     # (babble_tpu/sim/) can drive nodes on virtual time. Production uses

@@ -31,6 +31,8 @@ class Core:
         logger: Optional[logging.Logger] = None,
         consensus_backend: str = "cpu",
         mesh_devices: int = 0,
+        dispatch_queue_depth: int = 4,
+        dispatch_batch_deadline: float = 0.0,
         obs=None,
     ):
         self.id = id_
@@ -54,6 +56,13 @@ class Core:
             raise ValueError(f"unknown consensus backend: {consensus_backend!r}")
         self.consensus_backend = consensus_backend
         self.mesh_devices = mesh_devices
+        # async dispatch knobs (Config.dispatch_queue_depth /
+        # dispatch_batch_deadline): bound the in-flight device dispatch
+        # queue and the cross-round batching hold, for both the live
+        # single-device engine and the queued-mesh rung. depth 0 disables
+        # the queued-mesh rung (sync one-shot mesh calls only).
+        self.dispatch_queue_depth = dispatch_queue_depth
+        self.dispatch_batch_deadline = dispatch_batch_deadline
         self._mesh = None  # built lazily on the first mesh-backend run
         self.device_consensus_runs = 0
         self.device_consensus_fallbacks = 0
@@ -272,6 +281,10 @@ class Core:
         if getattr(self.hg, "_live_device_engine", None) is not None:
             self.live_demotions += 1
         self._drop_live_engine()
+        # in-flight mesh dispatches were staged against pre-reset state;
+        # their snapshots alias containers the reset invalidated — discard
+        # (nothing from them was stamped, the next serve restages)
+        self._drop_mesh_queue()
         self._live_retry_at = self._consensus_calls + 2
         self.run_consensus()
 
@@ -321,12 +334,65 @@ class Core:
                 self.hg.run_consensus()
                 return
             if self.mesh_devices > 1:
-                # mesh-sharded one-shot path (--mesh-devices): the
-                # incremental live engine is single-device by design, so
-                # a mesh node re-stages per call and pays O(E) host work
-                # for multi-chip compute (BASELINE config #5's deployment
-                # shape); unsupported states fall to the CPU engine like
-                # the rest of the ladder
+                # mesh ladder (--mesh-devices): queued async dispatch ->
+                # sync one-shot mesh -> CPU. The queued rung (ISSUE 6)
+                # overlaps the sharded pipeline with gossip through a
+                # bounded dispatch queue; it shares the live engine's
+                # demote/heal machinery (bounded backoff, counted
+                # demotions/re-attaches) because it is the mesh analogue
+                # of that rung. The sync one-shot path remains for
+                # post-reset states (host-delegated decision timing) and
+                # as the recompute safety net after a queue demotion.
+                if (
+                    self.dispatch_queue_depth > 0
+                    and self._consensus_calls >= self._live_retry_at
+                ):
+                    from ..tpu.dispatch import run_consensus_mesh_queued
+
+                    attached = (
+                        getattr(self.hg, "_mesh_dispatch_queue", None)
+                        is not None
+                    )
+                    try:
+                        run_consensus_mesh_queued(
+                            self.hg, self._get_mesh(),
+                            queue_depth=self.dispatch_queue_depth,
+                            batch_deadline=self.dispatch_batch_deadline,
+                        )
+                        self.device_consensus_runs += 1
+                        self._note_device_up()
+                        if not attached and self.live_demotions > 0:
+                            self.live_reattaches += 1
+                            self.logger.info(
+                                "queued mesh dispatch re-attached "
+                                "(demotions=%d)", self.live_demotions,
+                            )
+                        self._live_backoff = 1
+                        return
+                    except Exception as e:  # noqa: BLE001 — in-flight
+                        # results are discarded wholesale (nothing was
+                        # stamped from them), so the one-shot restage
+                        # below recomputes everything from the store
+                        if attached:
+                            self.live_demotions += 1
+                        self._live_backoff = min(self._live_backoff * 2, 64)
+                        self._live_retry_at = (
+                            self._consensus_calls + self._live_backoff
+                        )
+                        self._drop_mesh_queue()
+                        if attached:
+                            log = (
+                                self.logger.info
+                                if isinstance(e, GridUnsupported)
+                                else self.logger.warning
+                            )
+                        else:
+                            log = self.logger.debug
+                        log(
+                            "queued mesh dispatch unavailable (%s); "
+                            "one-shot mesh path, retry in %d calls",
+                            e, self._live_backoff,
+                        )
                 try:
                     run_consensus_device(self.hg, mesh=self._get_mesh())
                     self.device_consensus_runs += 1
@@ -343,7 +409,11 @@ class Core:
                     getattr(self.hg, "_live_device_engine", None) is not None
                 )
                 try:
-                    run_consensus_live(self.hg)
+                    run_consensus_live(
+                        self.hg,
+                        queue_depth=self.dispatch_queue_depth,
+                        batch_deadline=self.dispatch_batch_deadline,
+                    )
                     self.device_consensus_runs += 1
                     self._note_device_up()
                     if not attached and self.live_demotions > 0:
@@ -454,6 +524,24 @@ class Core:
         if eng is not None:
             eng.detach()
             self.hg._live_device_engine = None
+
+    def _drop_mesh_queue(self) -> None:
+        q = getattr(self.hg, "_mesh_dispatch_queue", None)
+        if q is not None:
+            q.detach()  # in-flight results are never stamped
+            self.hg._mesh_dispatch_queue = None
+
+    def flush_device_dispatch(self) -> None:
+        """Blocking barrier for drivers/benches/shutdown: integrate every
+        in-flight device dispatch (queued-mesh and live-engine queues) so
+        the store reflects all staged work before assertions or exit."""
+        q = getattr(self.hg, "_mesh_dispatch_queue", None)
+        if q is not None:
+            q.flush()
+        if getattr(self.hg, "_live_device_engine", None) is not None:
+            from ..tpu.live import flush_live_engine
+
+            flush_live_engine(self.hg)
 
     def add_transactions(self, txs: List[bytes]) -> None:
         self.transaction_pool.extend(txs)

@@ -105,6 +105,8 @@ class Node(NodeStateMachine):
             id_, key, pmap, store, self.commit_ch, conf.logger,
             consensus_backend=conf.consensus_backend,
             mesh_devices=getattr(conf, "mesh_devices", 0),
+            dispatch_queue_depth=getattr(conf, "dispatch_queue_depth", 4),
+            dispatch_batch_deadline=getattr(conf, "dispatch_batch_deadline", 0.0),
             obs=self.obs,
         )
         self.core_lock = threading.Lock()

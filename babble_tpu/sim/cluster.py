@@ -98,7 +98,10 @@ class SimCluster:
         seed: int = 0,
         plan: Optional[FaultPlan] = None,
         store: str = "inmem",
-        backend: str = "cpu",
+        backend: Any = "cpu",
+        mesh_devices: int = 0,
+        dispatch_queue_depth: int = 4,
+        dispatch_batch_deadline: float = 0.0,
         heartbeat: float = 0.05,
         tcp_timeout: float = 1.0,
         sync_limit: int = 300,
@@ -118,7 +121,20 @@ class SimCluster:
         self.seed = seed
         self.plan = plan or FaultPlan()
         self.store_kind = store
+        # backend may be one name for the whole cluster or a per-node
+        # sequence — a MIXED cluster (cpu nodes gossiping with mesh
+        # nodes) is the strictest differential we have: the divergence
+        # checker byte-compares their blocks continuously
+        if isinstance(backend, str):
+            self.backends = [backend] * n
+        else:
+            self.backends = list(backend)
+            if len(self.backends) != n:
+                raise ValueError(f"need {n} backends, got {len(self.backends)}")
         self.backend = backend
+        self.mesh_devices = mesh_devices
+        self.dispatch_queue_depth = dispatch_queue_depth
+        self.dispatch_batch_deadline = dispatch_batch_deadline
         self.heartbeat = heartbeat
         self.tcp_timeout = tcp_timeout
         self.sync_limit = sync_limit
@@ -179,7 +195,10 @@ class SimCluster:
             tcp_timeout=self.tcp_timeout,
             cache_size=self.cache_size,
             sync_limit=self.sync_limit,
-            consensus_backend=self.backend,
+            consensus_backend=self.backends[sn.index],
+            mesh_devices=self.mesh_devices,
+            dispatch_queue_depth=self.dispatch_queue_depth,
+            dispatch_batch_deadline=self.dispatch_batch_deadline,
             clock=self.clock,
             rng=sn.rng,
             logger=self.logger,
@@ -439,7 +458,7 @@ class SimCluster:
             "plan": self.plan.to_dict(),
             "n": self.n,
             "store": self.store_kind,
-            "backend": self.backend,
+            "backend": self.backends,
             "virtual_time": self.clock.now,
             "events_run": self.sched.events_run,
             "trace": self.trace,
@@ -621,6 +640,15 @@ class SimCluster:
     def shutdown(self) -> None:
         for sn in self.sns:
             if not sn.crashed and sn.node is not None:
+                # a mesh node may have a dispatch worker mid-execution;
+                # an orphaned daemon thread inside JAX at interpreter
+                # exit aborts the process, so wait it out first
+                q = getattr(sn.node.core.hg, "_mesh_dispatch_queue", None)
+                if q is not None:
+                    try:
+                        q.quiesce()
+                    except Exception:  # noqa: BLE001
+                        pass
                 try:
                     sn.node.core.hg.store.close()
                 except Exception:  # noqa: BLE001

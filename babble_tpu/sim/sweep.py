@@ -24,7 +24,10 @@ def run_one(
     plan: Union[str, FaultPlan] = "clean",
     n: int = 4,
     store: str = "inmem",
-    backend: str = "cpu",
+    backend: Any = "cpu",
+    mesh_devices: int = 0,
+    dispatch_queue_depth: int = 4,
+    dispatch_batch_deadline: float = 0.0,
     until: Optional[float] = 30.0,
     target_block: Optional[int] = None,
     artifact_dir: str = "docs/artifacts",
@@ -47,6 +50,9 @@ def run_one(
         plan=plan,
         store=store,
         backend=backend,
+        mesh_devices=mesh_devices,
+        dispatch_queue_depth=dispatch_queue_depth,
+        dispatch_batch_deadline=dispatch_batch_deadline,
         store_dir=store_dir,
         artifact_dir=artifact_dir,
         heartbeat=heartbeat,

@@ -471,13 +471,19 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         labels=("path",),
     )
     if mesh is not None:
+        from .dispatch import _MESH_EXEC_LOCK
         from .sharded import sharded_frontier_passes, sharded_run_passes
 
+        # serialize against queued-mesh workers: an orphaned dispatch
+        # (demotion discards the queue, not the running worker) would
+        # otherwise interleave collectives with this program and
+        # deadlock the mesh (tpu/dispatch.py _MESH_EXEC_LOCK)
         _t1 = clock.monotonic()
-        if _frontier_safe(grid):
-            res = sharded_frontier_passes(mesh, grid)
-        else:
-            res = sharded_run_passes(mesh, grid)
+        with _MESH_EXEC_LOCK:
+            if _frontier_safe(grid):
+                res = sharded_frontier_passes(mesh, grid)
+            else:
+                res = sharded_run_passes(mesh, grid)
         _m_run.labels(path="mesh").observe(clock.monotonic() - _t1)
         obs.gauge(
             "babble_mesh_staged_events",
@@ -491,6 +497,26 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         _t1 = clock.monotonic()
         res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
         _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
+
+    integrate_pass_results(hg, grid, res)
+
+
+def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None:
+    """Write device pass results back into the host hashgraph and run the
+    host passes 4-5 — the shared integration tail of every one-shot-style
+    device call.
+
+    `topo_hi` (the hashgraph's topological index at STAGING time) is the
+    queued-dispatch escape hatch (tpu/dispatch.py): by integration time
+    the hashgraph may hold events the grid never modeled. An undetermined
+    event inserted at/after topo_hi is simply not covered by this dispatch
+    (the next staging models it); an unmodeled event from BEFORE the
+    staging means the walk silently lost one — GridUnsupported, because
+    silently never receiving it would skew block composition. With
+    topo_hi=None (the synchronous one-shot path) every undetermined event
+    must be in the grid, as before."""
+    from ..common import StoreErr, StoreErrType, is_store_err
+    from ..hashgraph import RoundInfo, PendingRound
 
     # --- write-back: DivideRounds (reference: hashgraph.go:767-849) ---
     # validate the WHOLE batch before stamping anything: a partial stamp
@@ -599,18 +625,36 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
                 round_infos[pr.index] = hg.store.get_round(pr.index)
 
     # --- write-back: DecideRoundReceived (reference: hashgraph.go:951-1036) ---
-    rr_clean = admissible_receptions(
-        hg, round_infos,
-        (
-            (h, int(res.received[row_of[h]]))
-            for h in hg.undetermined_events
-            if int(res.received[row_of[h]]) >= 0
-        ),
-    )
+    def _covered(h):
+        """Grid row for h, or None when h postdates this dispatch's
+        staging (queued path only — the next staging covers it)."""
+        row = row_of.get(h)
+        if row is not None:
+            return row
+        if topo_hi is not None:
+            try:
+                ev = hg.store.get_event(h)
+            except StoreErr:
+                ev = None
+            if ev is not None and ev.topological_index >= topo_hi:
+                return None
+        raise GridUnsupported(f"undetermined event unmodeled ({h[:18]}…)")
+
+    def _proposed():
+        for h in hg.undetermined_events:
+            row = _covered(h)
+            if row is None:
+                continue
+            rr = int(res.received[row])
+            if rr >= 0:
+                yield h, rr
+
+    rr_clean = admissible_receptions(hg, round_infos, _proposed())
     if rr_clean:
         new_undetermined = []
         for h in hg.undetermined_events:
-            rr = int(res.received[row_of[h]])
+            row = _covered(h)
+            rr = -1 if row is None else int(res.received[row])
             if rr >= 0:
                 ev = hg.store.get_event(h)
                 ev.set_round_received(rr)

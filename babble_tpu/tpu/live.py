@@ -70,6 +70,14 @@ def derive_fd_updates(grid: DagGrid) -> List[List[Tuple[int, int, int]]]:
 ENGINE_DEFAULTS = dict(
     e_cap=1 << 16, r_cap=64, batch_cap=64, upd_cap=8192, e_win=8192,
     r_win=64,
+    # async dispatch queue (ISSUE 6): up to queue_depth dispatches in
+    # flight before the serve path blocks to integrate the oldest; 1
+    # reproduces the round-3 single-slot overlap. batch_deadline > 0
+    # holds gossip-staged rows for that many Clock seconds (or until
+    # batch_cap rows accumulate) before dispatching, so the device sees
+    # fewer, larger trains. Node configs override both via
+    # Config.dispatch_queue_depth / dispatch_batch_deadline.
+    queue_depth=4, batch_deadline=0.0,
 )
 
 
@@ -87,7 +95,8 @@ class LiveDeviceEngine:
 
     def __init__(self, hg, e_cap: int = None, r_cap: int = None,
                  batch_cap: int = None, upd_cap: int = None,
-                 e_win: int = None, r_win: int = None):
+                 e_win: int = None, r_win: int = None,
+                 queue_depth: int = None, batch_deadline: float = None):
         d = ENGINE_DEFAULTS
         self.hg = hg
         self.n = len(hg.participants.to_peer_slice())
@@ -125,11 +134,31 @@ class LiveDeviceEngine:
         )
         # pipelined-fetch discipline (VERDICT r3 #2): flips on when the
         # measured blocking fetch is consistently expensive (tunneled
-        # device); inflight = (_AsyncFetch, snapshot) of the dispatch
-        # whose results the NEXT consensus call integrates
+        # device). inflight is a bounded FIFO of
+        # (_AsyncFetch, snapshot, t_dispatch) tuples — up to queue_depth
+        # dispatches ride concurrently, integrated oldest-first on
+        # DETERMINISTIC conditions only (queue full, or no dispatch this
+        # call) so same-seed sim runs never diverge on thread timing.
         self.async_fetch = ENGINE_DEFAULTS.get("async_fetch") is True
-        self.inflight: Optional[tuple] = None
+        self.queue_depth = (
+            d["queue_depth"] if queue_depth is None else queue_depth
+        )
+        self.batch_deadline = (
+            d["batch_deadline"] if batch_deadline is None else batch_deadline
+        )
+        self.inflight: List[tuple] = []
+        self._pending_since: Optional[float] = None
         self._slow_fetches = 0
+        self._m_qdepth = hg.obs.gauge(
+            "babble_device_queue_depth",
+            "Device dispatches currently in flight in the async queue",
+        )
+        self._m_overlap = hg.obs.histogram(
+            "babble_device_overlap_utilization",
+            "Fraction of each dispatch's in-flight time overlapped with "
+            "gossip (1.0 = the fetch never blocked the serve path)",
+            buckets=[i / 10 for i in range(11)],
+        )
         self.state: IncState = init_state(self.n, self.e_cap, self.r_cap)
         self.row_of: Dict[str, int] = {}
         self.hashes: List[str] = []
@@ -143,12 +172,15 @@ class LiveDeviceEngine:
         """Called by Hashgraph.insert_event with the event and the
         (ancestor_hash, creator_pos, index) first-descendant cells its
         insert wrote."""
+        if not self.pending:
+            # batch-deadline anchor, on the injected Clock (sim-safe)
+            self._pending_since = self.hg.obs.clock.monotonic()
         self.pending.append((event, fd_writes))
 
     def detach(self) -> None:
         if getattr(self.hg, "insert_listener", None) is self._on_insert:
             self.hg.insert_listener = None
-        self.inflight = None  # results of a dropped engine are never stamped
+        self.inflight = []  # results of a dropped engine are never stamped
 
     # -- construction ------------------------------------------------------
 
@@ -306,6 +338,11 @@ class LiveDeviceEngine:
         """
         from ..common import StoreErr
 
+        if self.inflight:
+            # invariant (docs/tpu.md backend ladder): a rebase replaces
+            # the row containers in-flight snapshots alias — callers must
+            # drain the dispatch queue first (_settle_capacity does)
+            raise GridUnsupported("rebase with dispatches in flight")
         hg = self.hg
         base, floor = self._attach_base_round()
         if base <= self.round_base:
@@ -719,7 +756,8 @@ def _unpack_results(packed, e_win: int, r_cap: int, n: int):
             int(flags[2]))
 
 
-def run_consensus_live(hg) -> None:
+def run_consensus_live(hg, queue_depth: int = None,
+                       batch_deadline: float = None) -> None:
     """Incremental device consensus for a live node: advance the persistent
     state by the events inserted since the last call, then write decisions
     back and run the host passes (mirrors engine.run_consensus_device's
@@ -734,20 +772,26 @@ def run_consensus_live(hg) -> None:
     - pipelined (self-activating): when the measured blocking fetch is
       expensive (a tunneled device; threshold ASYNC_FETCH_MIN_S over 3
       consecutive calls), the fetch moves OFF the consensus critical
-      path: each call integrates the PREVIOUS dispatch's results (already
-      resident host-side via a background reader thread) and launches a
-      new dispatch whose transfer overlaps the next gossip interval.
-      Decisions lag one sync — pure timing, not content: rounds, fame,
-      and receptions are DAG facts, so block bodies stay byte-identical
-      (pinned by the strict joiner differentials), they just seal one
-      call later. The write-back validation gates run unchanged at
-      integration time against a dispatch-time snapshot of the row
-      mapping (rebases build fresh containers, so snapshots are O(1)
-      references).
+      path: up to ``queue_depth`` dispatches ride concurrently, each
+      call integrating the OLDEST dispatch's results (already resident
+      host-side via a background reader thread) and launching a new
+      dispatch whose transfer overlaps the next gossip intervals.
+      Decisions lag up to queue_depth syncs — pure timing, not content:
+      rounds, fame, and receptions are DAG facts, so block bodies stay
+      byte-identical (pinned by the strict joiner differentials), they
+      just seal a few calls later. The write-back validation gates run
+      unchanged at integration time against a dispatch-time snapshot of
+      the row mapping (rebases build fresh containers, so snapshots are
+      O(1) references), and integration order is FIFO so parents' rounds
+      always land before children's. Integration TRIGGERS are
+      deterministic (queue occupancy and call sequence, never thread
+      completion state) so same-seed sim runs stay byte-identical.
     """
     eng: Optional[LiveDeviceEngine] = getattr(hg, "_live_device_engine", None)
     if eng is None:
-        eng = LiveDeviceEngine(hg)
+        eng = LiveDeviceEngine(
+            hg, queue_depth=queue_depth, batch_deadline=batch_deadline
+        )
         hg._live_device_engine = eng
         # the bootstrap replayed the whole pre-existing DAG on device; its
         # rows still need the host write-back — the attach call is always
@@ -862,31 +906,90 @@ def _run_sync(hg, eng: LiveDeviceEngine, new_rows: List[int]) -> None:
         eng.async_fetch = True
 
 
-def _run_pipelined(hg, eng: LiveDeviceEngine) -> None:
-    """Integrate the previous dispatch, then launch a new one whose
-    transfer rides the gossip interval instead of the core lock."""
-    if eng.inflight is not None:
-        clock = hg.obs.clock
-        fetch, snap = eng.inflight
-        eng.inflight = None
-        t0 = clock.monotonic()
-        packed = fetch.result()  # normally already resident
-        dt = clock.monotonic() - t0
-        eng._m_fetch.observe(dt)
-        hg.obs.tracer.record(
-            "device.fetch", t0, dt, {"node": hg.obs.node_id},
-        )
-        eng.consensus_calls += 1
-        last_round_rel = _integrate(hg, eng, packed, snap)
-        # capacity BEFORE the next dispatch: a rebase must never run with
-        # a dispatch in flight (it reads store rounds the integration just
-        # wrote, and the next dispatch must see the rebased state)
-        _manage_capacity(eng, last_round_rel)
+def _integrate_oldest(hg, eng: LiveDeviceEngine) -> int:
+    """Pop + integrate the oldest in-flight dispatch (FIFO — parents'
+    rounds land before children's). Blocks only if the background reader
+    has not finished; the blocked fraction of the dispatch's in-flight
+    wall time feeds the overlap-utilization histogram."""
+    clock = hg.obs.clock
+    fetch, snap, t_disp = eng.inflight.pop(0)
+    t0 = clock.monotonic()
+    packed = fetch.result()  # normally already resident
+    dt = clock.monotonic() - t0
+    eng._m_fetch.observe(dt)
+    in_flight = max(t0 + dt - t_disp, 1e-9)
+    eng._m_overlap.observe(max(0.0, min(1.0, 1.0 - dt / in_flight)))
+    hg.obs.tracer.record(
+        "device.fetch", t0, dt, {"node": hg.obs.node_id},
+    )
+    eng.consensus_calls += 1
+    return _integrate(hg, eng, packed, snap)
 
-    new_rows = eng.advance()
-    if new_rows:
-        packed_dev, snap = _dispatch(eng, new_rows)
-        eng.inflight = (_AsyncFetch(packed_dev), snap)
+
+def _settle_capacity(hg, eng: LiveDeviceEngine, last_round_rel: int) -> None:
+    """Rebase barrier: a rebase must NEVER run with a dispatch in flight
+    (it replaces the row containers the in-flight snapshots alias and
+    reads store rounds the pending integrations have not written yet).
+    On capacity pressure the queue therefore drains fully — blocking
+    FIFO integration — before _manage_capacity may rebase."""
+    if not _capacity_soft(eng, last_round_rel):
+        return
+    while eng.inflight:
+        last_round_rel = _integrate_oldest(hg, eng)
+    _manage_capacity(eng, last_round_rel)
+
+
+def flush_live_engine(hg) -> None:
+    """Blocking barrier: integrate every in-flight live-engine dispatch
+    (drivers/benches call this via Core.flush_device_dispatch before
+    asserting on store state)."""
+    eng: Optional[LiveDeviceEngine] = getattr(hg, "_live_device_engine", None)
+    if eng is None or not eng.inflight:
+        return
+    last_round_rel = 0
+    while eng.inflight:
+        last_round_rel = _integrate_oldest(hg, eng)
+    _manage_capacity(eng, last_round_rel)
+    hg.process_decided_rounds()
+    hg.process_sig_pool()
+
+
+def _run_pipelined(hg, eng: LiveDeviceEngine) -> None:
+    """Multi-slot overlap: keep up to queue_depth dispatches in flight,
+    integrating the oldest when the queue is full (steady state:
+    integrate N-1, dispatch N) or when gossip staged nothing this call
+    (so the queue drains when traffic quiets). Both triggers are
+    functions of queue occupancy and the call sequence — never of
+    whether a background fetch happens to have finished — so the
+    integration schedule is deterministic under the sim's virtual clock.
+    """
+    clock = hg.obs.clock
+    depth = max(1, eng.queue_depth)
+    while len(eng.inflight) >= depth:
+        _settle_capacity(hg, eng, _integrate_oldest(hg, eng))
+
+    # cross-round dispatch batching: hold gossip-staged rows (all of
+    # them — a partial drain would strand events no snapshot models)
+    # until batch_cap rows accumulate or the Clock deadline passes
+    hold = (
+        eng.batch_deadline > 0.0
+        and eng.pending
+        and len(eng.pending) < eng.batch_cap
+        and eng._pending_since is not None
+        and clock.monotonic() - eng._pending_since < eng.batch_deadline
+    )
+    dispatched = False
+    if not hold:
+        new_rows = eng.advance()
+        if new_rows:
+            packed_dev, snap = _dispatch(eng, new_rows)
+            eng.inflight.append(
+                (_AsyncFetch(packed_dev), snap, clock.monotonic())
+            )
+            dispatched = True
+    if not dispatched and eng.inflight:
+        _settle_capacity(hg, eng, _integrate_oldest(hg, eng))
+    eng._m_qdepth.set(float(len(eng.inflight)))
 
     hg.process_decided_rounds()
     hg.process_sig_pool()
@@ -1089,24 +1192,31 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
     return last_round_rel
 
 
-def _manage_capacity(eng: LiveDeviceEngine, last_round_rel: int) -> None:
-    """Rebase BEFORE either device axis exhausts: the round axis needs
-    headroom for fame-decision lag (~8 rounds), the event axis for the
-    next few syncs' appends. A momentarily-stuck rebase (fame decisions
-    lagging, so the base cannot advance yet) is tolerated while hard
-    room remains — it is retried on every subsequent sync; only an
-    exhausted axis escalates to the caller's fallback. Under the
-    pipelined discipline last_round_rel is one dispatch old; the soft
-    margin (8 rounds) absorbs the single-sync lag."""
-    soft = (
+def _capacity_soft(eng: LiveDeviceEngine, last_round_rel: int) -> bool:
+    """Soft capacity-pressure predicate: the round axis needs headroom
+    for fame-decision lag (~8 rounds), the event axis for the next few
+    syncs' appends. len(eng.hashes) is the LIVE count, so rows appended
+    by still-queued dispatches are included (conservative)."""
+    return (
         last_round_rel >= eng.r_cap - 8
         or len(eng.hashes) >= eng.e_cap - 4 * eng.batch_cap
     )
+
+
+def _manage_capacity(eng: LiveDeviceEngine, last_round_rel: int) -> None:
+    """Rebase BEFORE either device axis exhausts. A momentarily-stuck
+    rebase (fame decisions lagging, so the base cannot advance yet) is
+    tolerated while hard room remains — it is retried on every
+    subsequent sync; only an exhausted axis escalates to the caller's
+    fallback. Under the queued discipline last_round_rel is up to
+    queue_depth dispatches old; the soft margin (8 rounds) absorbs the
+    lag, and the caller (_settle_capacity) guarantees the in-flight
+    queue is empty before this may rebase."""
     hard = (
         last_round_rel >= eng.r_cap - 3
         or len(eng.hashes) >= eng.e_cap - eng.batch_cap
     )
-    if soft:
+    if _capacity_soft(eng, last_round_rel):
         try:
             eng.rebase()
         except GridUnsupported:

@@ -158,13 +158,21 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
     shp2 = P(axis, None)
     shp3 = P(axis, None, None)
     rep = P()
+    # buffer donation (ISSUE 6): votes/decided/famous/ss_s/wv_s/coin_s
+    # (positions 3-8) are freshly device_put per call by
+    # _sharded_fame_received and never read after the dispatch, so XLA
+    # may update them in place — the voting loop's working set stops
+    # double-buffering. last_round/i_rows/wvalid_s stay undonated
+    # (wvalid_s aliases setup state shared with the received tables).
+    # Platforms without donation (CPU test mesh) fall back to copies.
     return jax.jit(
         _shard_map(
             local_fame,
             mesh=mesh,
             in_specs=(rep, P(axis), shp2, shp3, shp2, shp2, shp3, shp2, shp2),
             out_specs=(shp3, shp2, shp2),
-        )
+        ),
+        donate_argnums=(3, 4, 5, 6, 7, 8),
     )
 
 

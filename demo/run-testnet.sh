@@ -9,6 +9,9 @@ N=${1:-4}
 CONF=${CONF:-/tmp/babble-tpu-demo}
 PY=${PY:-python3}
 BACKEND=${BACKEND:-cpu}
+MESH=${MESH:-0}          # BACKEND=tpu MESH=K shards consensus over K chips
+QUEUE_DEPTH=${QUEUE_DEPTH:-4}
+BATCH_DEADLINE=${BATCH_DEADLINE:-0}
 RATE=${RATE:-5}
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 
@@ -34,6 +37,9 @@ for i in $(seq 0 $((N - 1))); do
     --service-listen "127.0.0.1:$SERVICE" \
     --heartbeat 0.01 --timeout 0.2 --cache-size 50000 --sync-limit 500 \
     --consensus-backend "$BACKEND" \
+    --mesh-devices "$MESH" \
+    --dispatch-queue-depth "$QUEUE_DEPTH" \
+    --dispatch-batch-deadline "$BATCH_DEADLINE" \
     --log warn) >"$CONF/node$i/log" 2>&1 &
   pids+=($!)
 done

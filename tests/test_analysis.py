@@ -321,6 +321,42 @@ def test_jax_float_order_and_waiver(tmp_path):
     assert [(f.rule, f.line) for f in found] == [("jax-float-order", 7)]
 
 
+def test_jax_shard_mapped_function_host_sync(tmp_path):
+    # shard_map discovery: the sharded backend builds its per-shard
+    # device functions inside cached factories (tpu/sharded.py idiom),
+    # so discovery must catch `shard_map(f, ...)` anywhere in the module
+    # — including nested defs and the aliased/wrapped spellings — and
+    # audit every parameter as a tracer (no static_argnames channel).
+    found = _findings(
+        tmp_path, "babble_tpu/tpu/fixture.py", """\
+        import numpy as np
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+
+        def _fame_factory(mesh, specs):
+            def local_fame(votes, decided):
+                n = int(votes[0, 0])
+                if decided:
+                    return votes
+                return np.asarray(votes)
+
+            return _exp_shard_map(
+                local_fame, mesh=mesh, in_specs=specs, out_specs=specs
+            )
+
+
+        def unmapped_helper(votes):
+            return np.asarray(votes)
+        """,
+    )
+    assert sorted((f.rule, f.line) for f in found) == [
+        ("jax-host-sync", 7),       # int() on a shard_map tracer
+        ("jax-host-sync", 10),      # np.asarray mid-kernel
+        ("jax-tracer-branch", 8),   # `if decided:` on a tracer
+    ]
+    assert all(f.symbol == "local_fame" for f in found)
+
+
 def test_jax_rules_only_inside_staged_functions(tmp_path):
     found = _findings(
         tmp_path, "babble_tpu/tpu/fixture.py", """\

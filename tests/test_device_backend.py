@@ -421,8 +421,12 @@ def test_live_engine_reattaches_after_fast_sync():
             f"calls={node.core._consensus_calls}, "
             f"state={node.get_state()})"
         )
-        # ... and keeps serving: runs grow without the engine dropping
+        # ... and KEEPS serving (the r05 joiner-liveness gap): runs must
+        # grow on the SAME attached engine with no fresh demotion —
+        # device_consensus_runs alone would also count one-shot ladder
+        # runs after a silent drop, which is exactly the gap
         runs_before = node.core.device_consensus_runs
+        demotions_at_attach = node.core.live_demotions
         deadline = _time.monotonic() + 120 * load_scale()
         while (
             node.core.device_consensus_runs <= runs_before
@@ -431,6 +435,14 @@ def test_live_engine_reattaches_after_fast_sync():
             target += 1
             bombard_and_wait(nodes, proxies, target_block=target, timeout_s=240)
         assert node.core.device_consensus_runs > runs_before
+        assert getattr(node.core.hg, "_live_device_engine", None) is eng, (
+            "live engine dropped again after re-attach "
+            f"(demotions={node.core.live_demotions})"
+        )
+        assert node.core.live_demotions == demotions_at_attach, (
+            "fresh demotion after re-attach: the engine is flapping, "
+            "not serving"
+        )
     finally:
         shutdown_nodes(nodes)
 

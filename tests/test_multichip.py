@@ -206,6 +206,7 @@ def test_tpu_import_initializes_no_backend():
         import babble_tpu.tpu.frontier
         import babble_tpu.tpu.incremental
         import babble_tpu.tpu.live
+        import babble_tpu.tpu.dispatch
         assert not xla_bridge.backends_are_initialized(), (
             "importing babble_tpu.tpu initialized a JAX backend"
         )

@@ -382,3 +382,73 @@ def test_watchdog_quiet_on_healthy_run():
             assert snap["babble_consensus_stalled"]["series"][""] == 0.0
     finally:
         cluster.shutdown()
+
+
+# ----------------------------------------------------------------------
+# device-backend differential (ISSUE 6: the queued-mesh dispatch rung
+# must commit the same blocks as the CPU engine)
+# ----------------------------------------------------------------------
+
+def test_mixed_cpu_and_queued_mesh_cluster_byte_identical():
+    """Two CPU nodes and two queued-mesh nodes in ONE cluster. The
+    divergence checker byte-compares their settled blocks every 0.5
+    virtual seconds, so this is the strictest cross-backend gate the sim
+    has: a queued-mesh node whose async dispatch stamped a wrong round,
+    or integrated results out of FIFO order, commits different bytes and
+    the run raises immediately. Dispatch lag is allowed to shift WHEN a
+    mesh node seals (decisions are DAG facts) — the checker compares the
+    common settled prefix, so timing skew passes and content skew
+    fails."""
+    res = run_one(
+        7, plan="clean", n=4,
+        backend=("cpu", "cpu", "tpu", "tpu"),
+        mesh_devices=2,
+        dispatch_queue_depth=4,
+        dispatch_batch_deadline=0.2,
+        until=None, target_block=2,
+    )
+    assert res["ok"], res["error"]
+    assert res["reached_target"]
+    assert res["blocks_checked"] >= 2
+
+
+def test_queued_mesh_run_to_run_deterministic():
+    """The queued rung's integration triggers are functions of queue
+    occupancy and the call sequence — never of whether a worker thread
+    happens to have finished — so two same-seed runs must replay the
+    identical schedule: same digest, same causal-trace fingerprint, same
+    event count (tpu/dispatch.py's determinism discipline)."""
+    kwargs = dict(
+        plan="clean", n=4, backend="tpu", mesh_devices=2,
+        dispatch_queue_depth=4, dispatch_batch_deadline=0.2,
+        until=None, target_block=2,
+    )
+    a = run_one(9, **kwargs)
+    b = run_one(9, **kwargs)
+    assert a["ok"] and b["ok"], (a["error"], b["error"])
+    assert a["reached_target"] and b["reached_target"]
+    assert a["digest"] == b["digest"]
+    assert a["trace_fingerprint"] == b["trace_fingerprint"]
+    assert a["events_run"] == b["events_run"]
+    assert a["virtual_time"] == b["virtual_time"]
+
+
+def test_sync_mesh_rung_matches_cpu_digest():
+    """dispatch_queue_depth=0 disables the queued rung, leaving the sync
+    one-shot mesh path — which blocks call-for-call, so decisions land on
+    the same serve call as the CPU engine and the two backends produce
+    byte-identical committed history for the same seed. (The queued rung
+    is excluded from THIS gate on purpose: dispatch lag shifts which
+    self-event carries a block signature, signatures are inside event
+    hashes, and frame hashes cover event bytes — so cross-RUN digest
+    equality only holds for zero-lag rungs; the mixed-cluster test above
+    is the queued rung's equality gate.)"""
+    cpu = run_one(9, plan="clean", n=4, backend="cpu",
+                  until=None, target_block=2)
+    mesh = run_one(9, plan="clean", n=4, backend="tpu", mesh_devices=2,
+                   dispatch_queue_depth=0,
+                   until=None, target_block=2)
+    assert cpu["ok"] and mesh["ok"], (cpu["error"], mesh["error"])
+    assert cpu["digest"] == mesh["digest"]
+    assert cpu["events_run"] == mesh["events_run"]
+    assert cpu["virtual_time"] == mesh["virtual_time"]
