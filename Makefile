@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint bench soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint bench trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -30,6 +30,11 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# cross-round perf-trend gate over the archived BENCH_r*/MULTICHIP_r*
+# artifacts: fails on a >10% regression against the best prior round
+trend:
+	$(PY) scripts/bench_trend.py
 
 # adversarial-timing fast-sync soak (VERDICT r3 #5): chained-donor
 # fast-forward + device-engine reattach scenarios with stall diagnostics

@@ -27,12 +27,25 @@ Rules (waiver tag `obs-ok`):
   signatures and breaks wire compatibility with trace-unaware nodes.
   This rule makes that invariant a build failure instead of a review
   convention.
+- obs-flightrec-static-name — a flight-recorder emission
+  (`*.record(...)` on a flightrec/recorder receiver) whose record name
+  is not a string literal.  Record names feed the record catalog in
+  docs/observability.md and the flight-recorder determinism fingerprint
+  (docs/sim.md); a computed name breaks both, exactly as for spans.
+- obs-slo-decl — an SLO declaration (`*.objective(...)` on an slo
+  receiver) whose objective name OR `series=` argument is not a string
+  literal.  The objective table in docs/observability.md and the
+  `babble_slo_*` gauge label values must be statically enumerable, and
+  the series must be a reviewable literal so the referenced metric can
+  be checked against the catalog.
 
 Scope: any call `<recv>.counter|gauge|histogram(...)` where the receiver
 chain ends in `obs`, `registry`, `reg` or `metrics` — the conventional
 handles for the per-node Observability bundle and its MetricsRegistry —
-and any call `<recv>.span|record(...)` where it ends in `obs` or
-`tracer`.
+any call `<recv>.span|record(...)` where it ends in `obs` or `tracer`,
+any call `<recv>.record(...)` where it ends in `flightrec` or
+`recorder`, and any call `<recv>.objective(...)` where it ends in
+`slo`.
 """
 
 from __future__ import annotations
@@ -49,6 +62,12 @@ RECEIVER_TAILS = {"obs", "registry", "reg", "metrics"}
 
 TRACE_METHODS = {"span", "record"}
 TRACE_RECEIVER_TAILS = {"obs", "tracer"}
+
+FLIGHT_METHODS = {"record"}
+FLIGHT_RECEIVER_TAILS = {"flightrec", "recorder"}
+
+SLO_METHODS = {"objective"}
+SLO_RECEIVER_TAILS = {"slo"}
 
 # Vocabulary that must never appear in hashgraph/event.py (signed-body
 # construction): identifiers or short key-like strings naming the causal
@@ -93,6 +112,26 @@ def _trace_receiver(func: ast.Attribute) -> Optional[str]:
     return recv if tail in TRACE_RECEIVER_TAILS else None
 
 
+def _flight_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a flight-recorder emission, or None when
+    this is not a recorder call we police (e.g. `db.record(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in FLIGHT_RECEIVER_TAILS else None
+
+
+def _slo_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of an SLO declaration, or None when this is
+    not an engine call we police."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in SLO_RECEIVER_TAILS else None
+
+
 class _ObsVisitor(SymbolTracker):
     def __init__(self, sf: SourceFile) -> None:
         super().__init__()
@@ -118,7 +157,56 @@ class _ObsVisitor(SymbolTracker):
             recv = _trace_receiver(func)
             if recv is not None:
                 self._check_trace(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in FLIGHT_METHODS:
+            recv = _flight_receiver(func)
+            if recv is not None:
+                self._check_flight(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in SLO_METHODS:
+            recv = _slo_receiver(func)
+            if recv is not None:
+                self._check_slo(node, recv, func.attr)
         self.generic_visit(node)
+
+    def _check_flight(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-flightrec-static-name", node,
+                f"{recv}.{method}(...) emits a flight-recorder record with "
+                "a computed name; record names must be static string "
+                "literals — they feed the record catalog "
+                "(docs/observability.md) and the flight-recorder "
+                "determinism fingerprint (docs/sim.md), so a "
+                "runtime-computed name breaks both",
+            )
+
+    def _check_slo(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        series_arg: Optional[ast.AST] = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+            elif kw.arg == "series":
+                series_arg = kw.value
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-slo-decl", node,
+                f"{recv}.{method}(...) declares an SLO objective with a "
+                "computed name; objective names must be static string "
+                "literals — they label the babble_slo_* gauges and the "
+                "objective table in docs/observability.md",
+            )
+        if series_arg is None or not _is_str_literal(series_arg):
+            self._emit(
+                "obs-slo-decl", node,
+                f"{recv}.{method}(...) declares an SLO objective whose "
+                "series= is not a static string literal; the series must "
+                "be reviewable against the metric catalog "
+                "(docs/observability.md)",
+            )
 
     def _check_trace(self, node: ast.Call, recv: str, method: str) -> None:
         name_arg: Optional[ast.AST] = node.args[0] if node.args else None

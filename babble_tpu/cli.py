@@ -97,6 +97,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Log periodic metrics-registry snapshots at info "
                           "(the registry always serves GET /metrics on the "
                           "HTTP service regardless)")
+    run.add_argument("--flightrec-dir", default="",
+                     help="Write flight-recorder dump artifacts (stall/"
+                          "flap/SLO-breach triage) into this directory; "
+                          "empty keeps dumps in memory, served at "
+                          "GET /debug/flightrec either way")
+    run.add_argument("--no-slo", action="store_true",
+                     help="Disable the SLO engine (GET /debug/slo and the "
+                          "babble_slo_* burn-rate gauges)")
 
     kg = sub.add_parser("keygen", help="Create new key pair")
     kg.add_argument("--datadir", default=default_data_dir(),
@@ -231,6 +239,8 @@ def run_command(args: argparse.Namespace) -> int:
             dispatch_queue_depth=args.dispatch_queue_depth,
             dispatch_batch_deadline=args.dispatch_batch_deadline,
             metrics_log=args.metrics,
+            flightrec_dir=args.flightrec_dir or None,
+            slo_enabled=not args.no_slo,
             logger=logger,
         ),
     )
@@ -283,6 +293,8 @@ def sim_command(args: argparse.Namespace) -> int:
                 f"blocks={row['blocks_checked']} t={row['virtual_time']}"
                 f" restarts={row['restarts']} flips={row['catchup_flips']}"
             )
+            if not row["ok"] and row.get("flightrec"):
+                print(f"  flight-recorder triage: {row['flightrec']}")
 
         summary = run_sweep(
             range(args.seed, args.seed + args.sweep),
@@ -295,6 +307,11 @@ def sim_command(args: argparse.Namespace) -> int:
         if summary["failed"]:
             print(f"failing seeds: {summary['failed_seeds']}")
             print(f"replay artifacts: {summary['artifacts']}")
+            if summary.get("flightrec_artifacts"):
+                print(
+                    "flight-recorder triage: "
+                    f"{summary['flightrec_artifacts']}"
+                )
             return 1
         return 0
 

@@ -593,6 +593,9 @@ class Hashgraph:
                     # process_decided_rounds drops settled rounds again once
                     # decided, so no block is ever re-minted.
                     self.pending_rounds.append(PendingRound(round_number, False))
+                    self.obs.flightrec.record(
+                        "fame.reopen", round=round_number,
+                    )
 
                 round_info.add_event(hash_, is_witness)
                 self.store.set_round(round_number, round_info)
@@ -932,6 +935,10 @@ class Hashgraph:
             self._sig_backlog.pop(idx)
             self._sig_wait_commit.discard(idx)
         if beyond:
+            self.obs.flightrec.record(
+                "sig.pressure", kind="horizon", dropped=len(beyond),
+                last_block=last_block,
+            )
             self.logger.warning(
                 "sig backlog: dropped %d bucket(s) beyond horizon "
                 "(last_block=%d horizon=+%d max_index=%d)",
@@ -945,6 +952,10 @@ class Hashgraph:
             for idx in excess:
                 self._sig_backlog.pop(idx)
                 self._sig_wait_commit.discard(idx)
+            self.obs.flightrec.record(
+                "sig.pressure", kind="cap", dropped=len(excess),
+                last_block=last_block,
+            )
             self.logger.warning(
                 "sig backlog: evicted %d farthest-future bucket(s) over "
                 "the %d-bucket cap", len(excess), self.SIG_BACKLOG_MAX_BUCKETS,
@@ -1088,6 +1099,10 @@ class Hashgraph:
         )
 
     def reset(self, block: Block, frame: Frame) -> None:
+        self.obs.flightrec.record(
+            "hashgraph.reset", block=block.index(),
+            round=block.round_received(),
+        )
         # any incremental device state is invalid after a reset
         eng = getattr(self, "_live_device_engine", None)
         if eng is not None:

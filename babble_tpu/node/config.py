@@ -69,6 +69,18 @@ class Config:
     # babble_consensus_stalled gauge when round-received has not advanced
     # for this many Clock seconds despite pending work
     stall_deadline: float = 10.0
+    # black-box flight recorder (obs/flightrec.py): bounded ring of typed
+    # structured records dumped on stall/divergence/flap/SLO breach
+    flightrec_capacity: int = 2048
+    # directory flight-recorder dump artifacts are written to; None keeps
+    # dumps in memory only (served at GET /debug/flightrec either way)
+    flightrec_dir: Optional[str] = None
+    # SLO engine (obs/slo.py): declare default objectives over the
+    # registry and evaluate burn rates on the heartbeat tick; a breach
+    # triggers a flight-recorder dump
+    slo_enabled: bool = True
+    # submit->commit p99 objective threshold, Clock seconds
+    slo_commit_p99: float = 30.0
     # minimum seconds between Node.log_stats() snapshot lines — the
     # heartbeat fires every successful gossip exchange, which at test
     # heartbeats would be hundreds of log records a second

@@ -215,6 +215,11 @@ class Core:
                         peer is not None
                         and self.known_events().get(peer.id, -1) >= ev.index()
                     )
+                    if slot_taken:
+                        self.hg.obs.flightrec.record(
+                            "fork.evidence",
+                            creator=ev.creator()[:16], index=ev.index(),
+                        )
                     log = self.logger.warning if slot_taken else self.logger.debug
                     log(
                         "sync: dropped insert absent from store "
@@ -270,6 +275,10 @@ class Core:
         self.hg.reset(block, frame)
         if section is not None:
             self.hg.apply_section(section, block.index())
+        self.hg.obs.flightrec.record(
+            "ladder.fast_forward", block=block.index(),
+            round=block.round_received(),
+        )
         self.set_head_and_seq()
         self._device_down = False  # reset compacted the state back into range
         self._device_backoff = 1
@@ -363,6 +372,10 @@ class Core:
                         self._note_device_up()
                         if not attached and self.live_demotions > 0:
                             self.live_reattaches += 1
+                            self.hg.obs.flightrec.record(
+                                "ladder.reattach", rung="mesh_queued",
+                                demotions=self.live_demotions,
+                            )
                             self.logger.info(
                                 "queued mesh dispatch re-attached "
                                 "(demotions=%d)", self.live_demotions,
@@ -380,6 +393,15 @@ class Core:
                             self._consensus_calls + self._live_backoff
                         )
                         self._drop_mesh_queue()
+                        if attached:
+                            self.hg.obs.flightrec.record(
+                                "ladder.demote", rung="mesh_queued",
+                                error=type(e).__name__,
+                                backoff=self._live_backoff,
+                            )
+                            # 3 demotions in 10s = a flapping backend:
+                            # dump the ring while the evidence is fresh
+                            self.hg.obs.flightrec.note_flap("demotion")
                         if attached:
                             log = (
                                 self.logger.info
@@ -418,6 +440,10 @@ class Core:
                     self._note_device_up()
                     if not attached and self.live_demotions > 0:
                         self.live_reattaches += 1
+                        self.hg.obs.flightrec.record(
+                            "ladder.reattach", rung="live",
+                            demotions=self.live_demotions,
+                        )
                         self.logger.info(
                             "incremental device engine re-attached "
                             "(demotions=%d)", self.live_demotions,
@@ -437,6 +463,12 @@ class Core:
                     # states and stops meaning "engine dropped").
                     if attached:
                         self.live_demotions += 1
+                        self.hg.obs.flightrec.record(
+                            "ladder.demote", rung="live",
+                            error=type(e).__name__,
+                            backoff=min(self._live_backoff * 2, 64),
+                        )
+                        self.hg.obs.flightrec.note_flap("demotion")
                     self._live_backoff = min(self._live_backoff * 2, 64)
                     self._live_retry_at = (
                         self._consensus_calls + self._live_backoff
@@ -480,6 +512,11 @@ class Core:
         self.device_consensus_fallbacks += 1
         self._device_backoff = min(self._device_backoff * 2, 256)
         self._device_retry_at = self._consensus_calls + self._device_backoff
+        if first:
+            self.hg.obs.flightrec.record(
+                "ladder.device_down", what=what, error=type(e).__name__,
+                backoff=self._device_backoff,
+            )
         log = self.logger.info if first else self.logger.debug
         log(
             "%s unsupported (%s); using CPU, retry in %d calls",
@@ -490,6 +527,10 @@ class Core:
         if self._device_down:
             self._device_down = False
             self.device_heals += 1
+            self.hg.obs.flightrec.record(
+                "ladder.device_heal", heals=self.device_heals,
+                fallbacks=self.device_consensus_fallbacks,
+            )
             self.logger.info(
                 "device backend healed after %d fallbacks "
                 "(heals=%d)", self.device_consensus_fallbacks, self.device_heals,

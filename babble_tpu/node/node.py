@@ -25,7 +25,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..hashgraph import Block, Store, WireEvent
-from ..obs import DEFAULT_COUNT_BUCKETS, Observability
+from ..obs import DEFAULT_COUNT_BUCKETS, Observability, SLOEngine
 from ..net import (
     EagerSyncRequest,
     EagerSyncResponse,
@@ -100,7 +100,12 @@ class Node(NodeStateMachine):
         self.obs = Observability(
             clock=conf.clock, node_id=id_,
             trace_capacity=conf.trace_capacity, tracing=conf.tracing,
+            flightrec_capacity=getattr(conf, "flightrec_capacity", 2048),
         )
+        # flight-recorder dump artifacts land here (None = in-memory
+        # only); dumps are triggered by the watchdog/SLO/flap hooks below
+        self.obs.flightrec.dump_dir = getattr(conf, "flightrec_dir", None)
+        self.obs.flightrec.logger = conf.logger
         self.core = Core(
             id_, key, pmap, store, self.commit_ch, conf.logger,
             consensus_backend=conf.consensus_backend,
@@ -281,6 +286,62 @@ class Node(NodeStateMachine):
             ),
         )
 
+        self.obs.gauge(
+            "babble_flightrec_records",
+            "Records currently held in the flight-recorder ring",
+        ).set_function(lambda: float(len(self.obs.flightrec)))
+        self.obs.gauge(
+            "babble_flightrec_dumps",
+            "Flight-recorder dumps emitted since boot",
+        ).set_function(lambda: float(self.obs.flightrec.dumps))
+
+        # SLO engine (obs/slo.py): default objectives over series the
+        # registry already carries. Objectives over paths this node never
+        # takes (e.g. device series on a CPU backend) simply have no data
+        # and cannot breach. Evaluated beside watchdog.check() on the
+        # heartbeat tick; a breach transition dumps the flight recorder.
+        self.slo: Optional[SLOEngine] = None
+        if getattr(conf, "slo_enabled", True):
+            self.slo = SLOEngine(self.obs, logger=self.logger)
+            self.slo.objective(
+                "submit_commit_p99",
+                series="babble_commit_latency_seconds",
+                kind="p_below", quantile=0.99,
+                threshold=getattr(conf, "slo_commit_p99", 30.0),
+                description="p99 submit->commit latency stays under the "
+                            "configured bound",
+            )
+            self.slo.objective(
+                "round_advance",
+                series="babble_consensus_stalled",
+                kind="below", threshold=0.5,
+                description="round-received keeps advancing (the stall "
+                            "gauge stays 0)",
+            )
+            self.slo.objective(
+                "device_blocked",
+                series="babble_device_run_seconds",
+                kind="mean_below", threshold=0.3,
+                labels={"path": "mesh_queued"},
+                description="queued-mesh integration blocks < 300 ms/call "
+                            "on device results",
+            )
+            self.slo.objective(
+                "overlap_utilization",
+                series="babble_device_overlap_utilization",
+                kind="mean_above", threshold=0.25,
+                description="async dispatch overlaps at least a quarter "
+                            "of its in-flight time with gossip",
+            )
+            self.slo.objective(
+                "dispatch_queue_depth",
+                series="babble_device_queue_depth",
+                kind="below",
+                threshold=float(max(1, conf.dispatch_queue_depth)) + 0.5,
+                description="the dispatch queue is not pinned past its "
+                            "configured depth",
+            )
+
         # rate limit for log_stats (satellite: no full dict per heartbeat)
         self._last_stats_log = float("-inf")
 
@@ -383,6 +444,8 @@ class Node(NodeStateMachine):
             except queue.Empty:
                 continue
             self.watchdog.check()
+            if self.slo is not None:
+                self.slo.evaluate()
             if gossip:
                 # At most ONE outbound exchange in flight (deliberate
                 # deviation from the reference, node.go:180-196, which

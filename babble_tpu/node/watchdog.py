@@ -30,6 +30,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from ..common.clock import Clock
+from ..obs import log_buckets
 
 # local bound on distinct peers tracked; the metrics registry's
 # MAX_LABEL_SETS overflow is the second line of defence
@@ -68,12 +69,29 @@ class LivenessWatchdog:
         self._last_round: Optional[int] = None  # guarded-by: _lock
         self._last_advance = clock.monotonic()  # guarded-by: _lock
         self._stalled = False  # guarded-by: _lock
+        self._stall_began: Optional[float] = None  # guarded-by: _lock
+        # the flight recorder gets the stall/recover records and the
+        # auto-dump; attribute name `flightrec` is the lint convention
+        self.flightrec = obs.flightrec
         self._g_stalled = obs.gauge(
             "babble_consensus_stalled",
             "1 while round-received has not advanced within the stall "
             "deadline despite pending work",
         )
         self._g_stalled.set(0.0)
+        # ISSUE 7 satellite: episodes were uncountable once recovered —
+        # the gauge drops back to 0 and the history is gone
+        self._m_stalls = obs.counter(
+            "babble_consensus_stalls_total",
+            "Stall episodes detected since boot (the gauge only shows "
+            "the current one)",
+        )
+        self._m_stall_duration = obs.histogram(
+            "babble_consensus_stall_duration_seconds",
+            "Duration of each recovered stall episode, from last round "
+            "advance to recovery",
+            buckets=log_buckets(1.0, 2.0, 12),
+        )
         self._g_health = obs.gauge(
             "babble_peer_health",
             "Per-peer gossip sync success rate (successes / attempts)",
@@ -123,6 +141,7 @@ class LivenessWatchdog:
             read_failed = True  # next tick re-reads a settled view
         recovered = False
         stalled_now = False
+        episode_s = 0.0
         with self._lock:
             if read_failed:
                 rnd = self._last_round
@@ -130,11 +149,14 @@ class LivenessWatchdog:
                 # ANY change counts as progress — fast-forward can move
                 # the round backwards through a reset, which is still
                 # liveness, not a stall
+                began = self._stall_began
                 self._last_round = rnd
                 self._last_advance = now
                 if self._stalled:
                     self._stalled = False
+                    self._stall_began = None
                     recovered = True
+                    episode_s = now - (began if began is not None else now)
             elif (
                 not self._stalled
                 and now - self._last_advance > self.deadline
@@ -145,6 +167,7 @@ class LivenessWatchdog:
                     pending = 0
                 if pending > 0:
                     self._stalled = True
+                    self._stall_began = self._last_advance
                     stalled_now = True
             stalled = self._stalled
             last_round = self._last_round
@@ -160,9 +183,22 @@ class LivenessWatchdog:
                 "(deadline %.1fs, last round %s) with pending work",
                 waited, self.deadline, last_round,
             )
+            self._m_stalls.inc()
+            self.flightrec.record(
+                "watchdog.stall", waited=waited, deadline=self.deadline,
+                round=last_round,
+            )
+            # the black box exists for exactly this moment: dump the
+            # ring (ladder/dispatch history preceding the stall) now
+            self.flightrec.dump("consensus-stall", waited=waited,
+                                round=last_round)
         elif recovered:
             self.logger.info(
                 "consensus resumed: round advanced to %s", rnd,
+            )
+            self._m_stall_duration.observe(episode_s)
+            self.flightrec.record(
+                "watchdog.recover", duration=episode_s, round=rnd,
             )
         self._g_stalled.set(1.0 if stalled else 0.0)
         for addr, ph in peers:

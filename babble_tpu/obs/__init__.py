@@ -21,6 +21,11 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from ..common.clock import Clock, SYSTEM_CLOCK
+from .flightrec import (
+    DEFAULT_FLIGHT_CAPACITY,
+    FlightRecord,
+    FlightRecorder,
+)
 from .metrics import (
     Counter,
     DEFAULT_COUNT_BUCKETS,
@@ -33,6 +38,7 @@ from .metrics import (
     log_buckets,
 )
 from .trace import DEFAULT_SPAN_CAPACITY, Span, SpanTracer
+from .slo import SLObjective, SLOEngine
 from .tracectx import (
     DEFAULT_TRACE_CAPACITY,
     TraceContext,
@@ -44,6 +50,11 @@ from .tracectx import (
 
 __all__ = [
     "Observability",
+    "FlightRecorder",
+    "FlightRecord",
+    "SLOEngine",
+    "SLObjective",
+    "DEFAULT_FLIGHT_CAPACITY",
     "MetricsRegistry",
     "Counter",
     "Gauge",
@@ -72,11 +83,18 @@ class Observability:
     def __init__(self, clock: Optional[Clock] = None, node_id: int = 0,
                  span_capacity: int = DEFAULT_SPAN_CAPACITY,
                  trace_capacity: int = DEFAULT_TRACE_CAPACITY,
-                 tracing: bool = True):
+                 tracing: bool = True,
+                 flightrec_capacity: int = DEFAULT_FLIGHT_CAPACITY):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.node_id = node_id
         self.registry = MetricsRegistry()
         self.tracer = SpanTracer(clock=self.clock, capacity=span_capacity)
+        # black-box flight recorder (ISSUE 7): bounded ring of typed
+        # structured records dumped wholesale on stall/divergence/flap/
+        # SLO breach — same Clock seam, same determinism contract
+        self.flightrec = FlightRecorder(
+            clock=self.clock, node_id=node_id, capacity=flightrec_capacity,
+        )
         # cross-node causal tracing (ISSUE 5): live TraceContexts for
         # in-flight transactions, bounded, feeding per-stage histograms
         # and trace.* spans into the registry/tracer above

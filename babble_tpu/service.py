@@ -13,6 +13,10 @@ riding the service mux (reference: cmd/babble/main.go:4):
   fetch each peer's /debug/trace for the same trace id and merge all the
   docs into a single Chrome-trace timeline (one pid per node), so one
   transaction can be followed across the whole cluster in Perfetto
+- GET /debug/flightrec       — the black-box flight recorder's current
+  ring as JSON (obs/flightrec.py)
+- GET /debug/slo             — SLO objectives with per-window burn rates
+  (obs/slo.py; a fresh evaluation per request)
 
 and the Prometheus exposition of the node's typed metrics registry:
 
@@ -249,6 +253,21 @@ class Service:
                                     trace_id=tid,
                                 )
                             ).encode()
+                        elif self.path == "/debug/flightrec":
+                            obs = getattr(service.node, "obs", None)
+                            flightrec = getattr(obs, "flightrec", None)
+                            if flightrec is None:
+                                self.send_error(
+                                    404, "node has no flight recorder"
+                                )
+                                return
+                            body = json.dumps(flightrec.to_json()).encode()
+                        elif self.path == "/debug/slo":
+                            slo = getattr(service.node, "slo", None)
+                            if slo is None:
+                                self.send_error(404, "node has no SLO engine")
+                                return
+                            body = json.dumps(slo.status()).encode()
                         elif self.path.startswith("/debug/profile"):
                             q = parse_qs(urlparse(self.path).query)
                             secs = float(q.get("seconds", ["5"])[0])

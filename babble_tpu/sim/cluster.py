@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import random
 from hashlib import sha256
@@ -55,7 +56,7 @@ from ..node import Config, Node
 from ..node.state import NodeState
 from ..peers import Peer, Peers
 from ..proxy import InmemDummyClient
-from .checker import DivergenceChecker
+from .checker import DivergenceChecker, DivergenceError
 from .clock import SimClock
 from .faults import FaultPlan
 from .scheduler import SimScheduler
@@ -273,10 +274,13 @@ class SimCluster:
             return
         node = sn.node
         self._drain(sn)
-        # the threaded _babble loop runs the watchdog on every heartbeat
-        # tick; mirror that here so stall detection is part of the
+        # the threaded _babble loop runs the watchdog (and the SLO
+        # engine) on every heartbeat tick; mirror that here so stall
+        # detection and burn-rate evaluation are part of the
         # deterministic replay (gauge values ride virtual time)
         node.watchdog.check()
+        if node.slo is not None:
+            node.slo.evaluate()
         state = node.get_state()
         extra = 0.0
         if state == NodeState.CATCHING_UP:
@@ -394,6 +398,12 @@ class SimCluster:
         if sn.crashed:
             return
         self._trace(f"{sn.name} CRASH at t={self.clock.now:.3f}")
+        # black box first: capture what the node was doing as it dies
+        # (in-memory doc; export_flight_dumps writes it out on demand)
+        try:
+            sn.node.obs.flightrec.dump("crash", node=sn.name)
+        except Exception:  # noqa: BLE001 — the crash proceeds regardless
+            pass
         sn.crashed = True
         sn.gen += 1  # orphan every callback the dead process scheduled
         sn.exchange_inflight = False
@@ -465,8 +475,51 @@ class SimCluster:
         }
 
     def check_divergence(self) -> int:
-        """Raises DivergenceError (artifact dumped) on any mismatch."""
-        return self.checker.check(self.live_views(), self._context())
+        """Raises DivergenceError (artifact dumped) on any mismatch —
+        and dumps every live node's flight recorder beside it, so the
+        replay artifact comes with the "what was each node doing"
+        record stream."""
+        try:
+            return self.checker.check(self.live_views(), self._context())
+        except DivergenceError:
+            self.dump_flight_recorders("divergence")
+            raise
+
+    def dump_flight_recorders(self, reason: str) -> List[str]:
+        """Trigger an in-memory flight-recorder dump on every live node
+        (file export is separate — export_flight_dumps). Returns the
+        node names that actually dumped (suppression may skip some)."""
+        dumped = []
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            before = sn.node.obs.flightrec.dumps
+            sn.node.obs.flightrec.dump(reason, node=sn.name)
+            if sn.node.obs.flightrec.dumps > before:
+                dumped.append(sn.name)
+        return dumped
+
+    def export_flight_dumps(self, directory: str) -> List[str]:
+        """Write every node's accumulated in-memory dump docs as JSON
+        artifacts (sweep triage: called on the failure path only, so
+        healthy runs stay file-free). Deterministic filenames: node +
+        dump ordinal + reason."""
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        for sn in self.sns:
+            node = sn.node
+            if node is None:
+                continue
+            for doc in node.obs.flightrec.dump_docs:
+                path = os.path.join(
+                    directory,
+                    f"flightrec-seed{self.seed}-{sn.name}-"
+                    f"{doc['ordinal']:02d}-{doc['reason']}.json",
+                )
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                paths.append(path)
+        return paths
 
     def _all_reached(self, target: int) -> bool:
         for sn in self.sns:
@@ -551,6 +604,12 @@ class SimCluster:
             "commit_latency": self.latency_histograms(),
             "stage_latency": self.stage_histograms(),
             "trace_fingerprint": self.trace_fingerprint(),
+            "flightrec_fingerprint": self.flightrec_fingerprint(),
+            "flightrec_records": {
+                sn.name: len(sn.node.obs.flightrec)
+                for sn in self.sns
+                if not sn.crashed
+            },
             "digest": self.digest(),
         }
 
@@ -615,6 +674,19 @@ class SimCluster:
         return sha256(
             json.dumps(events, sort_keys=True).encode()
         ).hexdigest()
+
+    def flightrec_fingerprint(self) -> str:
+        """SHA-256 over every live node's canonical flight-record stream
+        bytes, in node order — the recorder's entry in the determinism
+        fingerprint: two runs of the same seed+plan must produce
+        byte-identical record streams (docs/sim.md)."""
+        h = sha256()
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            h.update(sn.name.encode())
+            h.update(sn.node.obs.flightrec.stream_bytes())
+        return h.hexdigest()
 
     def digest(self) -> str:
         """SHA-256 over every settled block body on every live node, in

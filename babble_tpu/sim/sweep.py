@@ -64,11 +64,16 @@ def run_one(
         res["ok"] = True
         res["error"] = None
         res["artifact"] = None
+        res["flightrec"] = []
     except DivergenceError as e:
         res = cluster.result()
         res["ok"] = False
         res["error"] = str(e)
         res["artifact"] = e.artifact_path
+        # triage artifacts: the flight-recorder dumps every node took
+        # during the run (the divergence dump plus any stall/flap/SLO
+        # dumps that preceded it), exported beside the replay artifact
+        res["flightrec"] = cluster.export_flight_dumps(artifact_dir)
     finally:
         cluster.shutdown()
         if tmp is not None:
@@ -115,6 +120,9 @@ def run_sweep(
         "failed": len(failures),
         "failed_seeds": [r["seed"] for r in failures],
         "artifacts": [r["artifact"] for r in failures if r["artifact"]],
+        "flightrec_artifacts": [
+            p for r in failures for p in r.get("flightrec", [])
+        ],
         "total_blocks_checked": sum(r["blocks_checked"] for r in rows),
         "rows": rows,
     }

@@ -144,6 +144,11 @@ class MeshDispatchQueue:
         """Discard every in-flight dispatch. Nothing was stamped from
         them, so the next path down the ladder recomputes from the store;
         the orphaned workers finish in the background and are dropped."""
+        if self.inflight:
+            self.hg.obs.flightrec.record(
+                "dispatch.detach", discarded=len(self.inflight),
+                dispatches=self.dispatches,
+            )
         self.inflight = []
 
     def quiesce(self) -> None:
@@ -237,6 +242,10 @@ class MeshDispatchQueue:
             (_AsyncPass(self.mesh, grid), grid, topo_hi, clock.monotonic())
         )
         self.dispatches += 1
+        hg.obs.flightrec.record(
+            "dispatch.enqueue", events=grid.e, topo_hi=topo_hi,
+            depth=len(self.inflight),
+        )
         return True
 
     def _integrate_oldest(self) -> None:
@@ -262,6 +271,10 @@ class MeshDispatchQueue:
         )
         integrate_pass_results(hg, grid, res, topo_hi=topo_hi)
         self.integrations += 1
+        hg.obs.flightrec.record(
+            "dispatch.integrate", blocked=dt, depth=len(self.inflight),
+            integrations=self.integrations,
+        )
 
 
 def run_consensus_mesh_queued(hg, mesh, queue_depth: int = 4,

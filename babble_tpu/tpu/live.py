@@ -362,6 +362,9 @@ class LiveDeviceEngine:
         self._install_state(base, floor, kept)
         self.rebases += 1
         self._m_rebase.inc()
+        hg.obs.flightrec.record(
+            "live.rebase", base=base, kept=len(kept), rebases=self.rebases,
+        )
 
     def _install_state(self, base: int, floor: int, kept: List[tuple]) -> None:
         """Assemble IncState host-side from (hash, event) rows of rounds
@@ -922,6 +925,9 @@ def _integrate_oldest(hg, eng: LiveDeviceEngine) -> int:
     hg.obs.tracer.record(
         "device.fetch", t0, dt, {"node": hg.obs.node_id},
     )
+    hg.obs.flightrec.record(
+        "live.integrate", blocked=dt, depth=len(eng.inflight),
+    )
     eng.consensus_calls += 1
     return _integrate(hg, eng, packed, snap)
 
@@ -987,6 +993,10 @@ def _run_pipelined(hg, eng: LiveDeviceEngine) -> None:
                 (_AsyncFetch(packed_dev), snap, clock.monotonic())
             )
             dispatched = True
+            hg.obs.flightrec.record(
+                "live.dispatch", rows=len(new_rows),
+                depth=len(eng.inflight),
+            )
     if not dispatched and eng.inflight:
         _settle_capacity(hg, eng, _integrate_oldest(hg, eng))
     eng._m_qdepth.set(float(len(eng.inflight)))

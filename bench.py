@@ -17,9 +17,15 @@ vs_baseline is value / 1e6 (the BASELINE.json target, since the reference
 publishes no numbers of its own). Drivers that parse the last stdout
 line keep working unchanged.
 
+`--slo` turns the perf trajectory from advisory into enforceable: the
+throughput gauge is declared as an SLO objective (obs/slo.py) and the
+process exits nonzero when the run breaches it. The SLO report goes to
+stderr so the headline stays the last stdout line.
+
 Runs on whatever JAX platform is available (real TPU under the driver).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -90,7 +96,35 @@ def load_grid():
     return grid
 
 
-def main():
+def slo_gate(obs, min_events_per_sec: float):
+    """Declare the throughput objective over the bench registry and
+    evaluate it once (cumulative single-sample evaluation — see
+    obs/slo.py). Returns (ok, status_doc). Factored out so tests can
+    gate a synthetic registry without running the device pipeline."""
+    from babble_tpu.obs import SLOEngine
+
+    slo = SLOEngine(obs)
+    slo.objective(
+        "bench_throughput",
+        series="babble_bench_events_per_second",
+        kind="above", threshold=min_events_per_sec,
+        description="benchmark throughput stays at or above the floor",
+    )
+    status = slo.evaluate()
+    return not slo.breached(), status
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slo", action="store_true",
+                    help="Gate the run on the throughput SLO: exit 1 "
+                         "when events/s falls below the floor")
+    ap.add_argument("--slo-min-events-per-sec", type=float,
+                    default=TARGET_EVENTS_PER_SEC,
+                    help="Throughput floor for --slo (default: the "
+                         "BASELINE.json 1M events/s target)")
+    args = ap.parse_args(argv)
+
     import jax
 
     from babble_tpu.tpu import kernels
@@ -211,6 +245,22 @@ def main():
         )
     )
 
+    if args.slo:
+        ok, status = slo_gate(obs, args.slo_min_events_per_sec)
+        print(
+            "SLO gate:",
+            json.dumps(status["objectives"], sort_keys=True),
+            file=sys.stderr,
+        )
+        if not ok:
+            print(
+                f"SLO BREACH: {events_per_sec:.0f} events/s under the "
+                f"{args.slo_min_events_per_sec:.0f} floor",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -26,10 +26,16 @@ per-discipline numbers and the metrics-registry snapshot:
    "vs_baseline": <queued/sync speedup>, "disciplines": {...},
    "metrics": {...}}
 
+`--slo` gates the run: the queued-mesh discipline's blocked device time
+per call is declared as a mean-below SLO objective (obs/slo.py) and the
+process exits nonzero on breach (report on stderr; the headline stays
+the last stdout line).
+
 Runs on whatever JAX platform is available (real TPU under the driver);
 the mesh uses up to 8 local devices.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -50,7 +56,35 @@ BATCH_SYNCS = 4     # queued_mesh: syncs accumulated per dispatch
 GOSSIP_INTERVAL_S = 0.01
 
 
-def main():
+def slo_gate(obs, max_blocked_s: float):
+    """Declare the queued-mesh blocked-time objective and evaluate once
+    (cumulative single-sample evaluation). Returns (ok, status_doc)."""
+    from babble_tpu.obs import SLOEngine
+
+    slo = SLOEngine(obs)
+    slo.objective(
+        "dispatch_blocked",
+        series="babble_bench_dispatch_blocked_seconds",
+        kind="mean_below", threshold=max_blocked_s,
+        labels={"path": "queued_mesh"},
+        description="queued-mesh blocked device time per sync stays "
+                    "under the ceiling",
+    )
+    status = slo.evaluate()
+    return not slo.breached(), status
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slo", action="store_true",
+                    help="Gate the run on the queued-mesh blocked-time "
+                         "SLO: exit 1 when mean blocked s/call exceeds "
+                         "the ceiling")
+    ap.add_argument("--slo-max-blocked-ms", type=float, default=150.0,
+                    help="Ceiling on queued-mesh mean blocked device "
+                         "ms per gossip sync for --slo")
+    args = ap.parse_args(argv)
+
     import jax
     import numpy as np
 
@@ -197,6 +231,23 @@ def main():
         )
     )
 
+    if args.slo:
+        ok, status = slo_gate(obs, args.slo_max_blocked_ms / 1e3)
+        print(
+            "SLO gate:",
+            json.dumps(status["objectives"], sort_keys=True),
+            file=sys.stderr,
+        )
+        if not ok:
+            print(
+                f"SLO BREACH: queued_mesh blocked "
+                f"{disciplines['queued_mesh']['ms_per_call']} ms/call over "
+                f"the {args.slo_max_blocked_ms} ms ceiling",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

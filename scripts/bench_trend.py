@@ -1,0 +1,156 @@
+"""Cross-round perf-trend gate: read every BENCH_r*.json and
+MULTICHIP_r*.json the driver has archived at the repo root, print the
+events/s and blocked-device-ms/call trajectories, and exit nonzero when
+the latest round regressed more than 10% against the best prior round.
+
+This is the trend half of the SLO story (ISSUE 7 satellite): bench.py
+--slo gates one run against an absolute floor; this script gates the
+run-to-run trajectory so a regression that still clears the floor is
+caught before it compounds. Wired as `make trend`.
+
+Artifact shapes handled (oldest rounds predate the structured headline):
+- BENCH_r*.json: {"rc", "tail", "parsed": {"value", "unit", ...}} —
+  value from "parsed", falling back to the last JSON line of "tail".
+- MULTICHIP_r*.json: {"rc", "ok", "tail"} — blocked ms/call from the
+  JSON headline (unit "ms/call") once it exists, else regexes over the
+  human OK line ("device-blocked N ms/call", then "device N ms/call").
+Rounds with rc != 0 or no extractable number are reported and skipped.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REGRESSION_TOLERANCE = 0.10
+
+
+def _round_of(path):
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _last_json_line(tail):
+    """The benches print their headline as the LAST stdout line; logs may
+    trail it, so scan from the bottom for the first parsable JSON object."""
+    for line in reversed((tail or "").splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def bench_value(doc):
+    """events/s of one BENCH round, or None."""
+    if doc.get("rc") != 0:
+        return None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(
+        parsed.get("value"), (int, float)
+    ):
+        return float(parsed["value"])
+    headline = _last_json_line(doc.get("tail"))
+    if headline and isinstance(headline.get("value"), (int, float)):
+        return float(headline["value"])
+    return None
+
+
+def multichip_value(doc):
+    """blocked device ms/call of one MULTICHIP round, or None."""
+    if doc.get("rc") != 0 or not doc.get("ok", True):
+        return None
+    headline = _last_json_line(doc.get("tail"))
+    if (
+        headline
+        and headline.get("unit") == "ms/call"
+        and isinstance(headline.get("value"), (int, float))
+    ):
+        return float(headline["value"])
+    tail = doc.get("tail") or ""
+    for pat in (
+        r"device-blocked ([0-9.]+) ms/call",
+        r"device ([0-9.]+) ms/call",
+    ):
+        m = re.search(pat, tail)
+        if m:
+            return float(m.group(1))
+    return None
+
+
+def load_series(pattern, extract):
+    """[(round, value-or-None)] sorted by round, one entry per artifact."""
+    series = []
+    for path in sorted(glob.glob(os.path.join(ROOT, pattern)), key=_round_of):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trend: unreadable {os.path.basename(path)}: {e}")
+            series.append((_round_of(path), None))
+            continue
+        series.append((_round_of(path), extract(doc)))
+    return series
+
+
+def check(name, series, unit, better):
+    """Print one trajectory; return False when the latest valid round is
+    >10% worse than the best prior valid round. `better` is max for
+    higher-is-better series, min for lower-is-better."""
+    valid = [(r, v) for r, v in series if v is not None]
+    line = "  " + " -> ".join(
+        f"r{r:02d}:{v:g}" if v is not None else f"r{r:02d}:-"
+        for r, v in series
+    )
+    print(f"{name} ({unit}):")
+    print(line if series else "  (no artifacts)")
+    if len(valid) < 2:
+        print("  fewer than two valid rounds — nothing to gate")
+        return True
+    latest_r, latest = valid[-1]
+    best = better(v for _, v in valid[:-1])
+    if better is max:
+        ok = latest >= best * (1.0 - REGRESSION_TOLERANCE)
+        rel = latest / best - 1.0
+    else:
+        ok = latest <= best * (1.0 + REGRESSION_TOLERANCE)
+        rel = best / latest - 1.0 if latest else 0.0
+    verdict = "ok" if ok else "REGRESSION"
+    print(
+        f"  latest r{latest_r:02d} = {latest:g} vs best prior {best:g} "
+        f"({rel:+.1%}): {verdict}"
+    )
+    return ok
+
+
+def main():
+    ok = True
+    ok &= check(
+        "bench throughput",
+        load_series("BENCH_r*.json", bench_value),
+        "events/s", max,
+    )
+    ok &= check(
+        "multichip blocked device time",
+        load_series("MULTICHIP_r*.json", multichip_value),
+        "ms/call", min,
+    )
+    if not ok:
+        print(
+            f"trend: latest round regressed >"
+            f"{REGRESSION_TOLERANCE:.0%} against the best prior round"
+        )
+        return 1
+    print("trend: no >10% regression against best prior round")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -460,6 +460,51 @@ def test_obs_ctx_in_event_rule(tmp_path):
     assert [f for f in found2 if f.rule == "obs-ctx-in-event"] == []
 
 
+def test_obs_flightrec_static_name_rule(tmp_path):
+    # flight-recorder emissions need literal record names (they feed the
+    # record catalog and the sim's flightrec fingerprint); a reasoned
+    # waiver suppresses, foreign .record receivers are not ours
+    found = _findings(
+        tmp_path, "babble_tpu/node/fixture.py", """\
+        def emit(obs, flightrec, kind, db):
+            obs.flightrec.record("ladder." + kind, rung="live")
+            flightrec.record("watchdog.stall", waited=1.0)
+            flightrec.record(f"dyn.{kind}")  # obs-ok: kinds are a literal enum
+            recorder.record(kind)
+            db.record(kind)
+        """,
+    )
+    flight = [f for f in found if f.rule == "obs-flightrec-static-name"]
+    assert [(f.rule, f.line) for f in flight] == [
+        ("obs-flightrec-static-name", 2),
+        ("obs-flightrec-static-name", 5),
+    ]
+    assert "static string literals" in flight[0].message
+
+
+def test_obs_slo_decl_rule(tmp_path):
+    # SLO declarations need literal objective names AND literal series;
+    # foreign .objective receivers are not ours
+    found = _findings(
+        tmp_path, "babble_tpu/node/fixture.py", """\
+        def declare(slo, name, series, planner):
+            slo.objective(name, series="babble_x_seconds",
+                          kind="p_below", threshold=1.0)
+            slo.objective("commit_p99", series=series,
+                          kind="p_below", threshold=1.0)
+            slo.objective("good", series="babble_y_seconds",
+                          kind="below", threshold=2.0)
+            planner.objective(name)
+        """,
+    )
+    decls = [f for f in found if f.rule == "obs-slo-decl"]
+    assert [(f.rule, f.line) for f in decls] == [
+        ("obs-slo-decl", 2),
+        ("obs-slo-decl", 4),
+    ]
+    assert any("series=" in f.message for f in decls)
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------

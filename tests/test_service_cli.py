@@ -209,3 +209,44 @@ def test_service_debug_endpoints():
     finally:
         svc.shutdown()
         shutdown_nodes(nodes)
+
+
+def test_service_flightrec_and_slo_endpoints():
+    """GET /debug/flightrec (the flight recorder's full state: ring,
+    counters, fingerprint) and GET /debug/slo (a fresh SLO evaluation) —
+    the triage surface of ISSUE 7."""
+    nodes, proxies = init_nodes(2)
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        run_nodes(nodes)
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+        bombard_and_wait(nodes, proxies, target_block=1)
+
+        fr = _get(base + "/debug/flightrec")
+        assert fr["node"] == nodes[0].id
+        assert fr["capacity"] >= 1
+        assert isinstance(fr["records"], list)
+        assert len(fr["fingerprint"]) == 64  # sha256 hex
+        for key in ("dropped", "dumps", "dumps_suppressed"):
+            assert fr[key] >= 0
+
+        slo = _get(base + "/debug/slo")
+        assert slo["windows"] == ["60s", "300s"]
+        names = {o["name"] for o in slo["objectives"]}
+        assert {"submit_commit_p99", "round_advance"} <= names
+        for obj in slo["objectives"]:
+            assert set(obj["burn"]) == {"60s", "300s"}
+            assert isinstance(obj["breached"], bool)
+        # a healthy committing run breaches nothing
+        commit = next(o for o in slo["objectives"]
+                      if o["name"] == "submit_commit_p99")
+        assert commit["breached"] is False
+        # the SLO gauges reached the scrape surface
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "# TYPE babble_slo_breached gauge" in text
+        assert "# TYPE babble_flightrec_records gauge" in text
+    finally:
+        svc.shutdown()
+        shutdown_nodes(nodes)

@@ -198,6 +198,16 @@ def test_divergence_dumps_artifact(tmp_path):
         cluster.checker.checked_upto = -1  # force a full re-check
         with pytest.raises(DivergenceError) as ei:
             cluster.check_divergence()
+        # the failure auto-dumped every live node's flight recorder —
+        # the triage bundle the sweep exports beside the artifact
+        for sn in cluster.sns:
+            docs = sn.node.obs.flightrec.dump_docs
+            assert docs and docs[-1]["reason"] == "divergence"
+        exported = cluster.export_flight_dumps(str(tmp_path / "artifacts"))
+        assert len(exported) == 4
+        for p in exported:
+            with open(p) as f:
+                assert json.load(f)["reason"] == "divergence"
     finally:
         cluster.shutdown()
     artifact_path = ei.value.artifact_path
