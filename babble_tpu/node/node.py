@@ -341,6 +341,15 @@ class Node(NodeStateMachine):
                 description="the dispatch queue is not pinned past its "
                             "configured depth",
             )
+            self.slo.objective(
+                "catchup_replay",
+                series="babble_catchup_replay_seconds",
+                kind="mean_below",
+                threshold=float(getattr(conf, "slo_catchup_replay", 30.0)),
+                description="log-diameter cold-path section replay "
+                            "(fast-sync / post-reset catch-up) stays under "
+                            "the latency cap",
+            )
 
         # rate limit for log_stats (satellite: no full dict per heartbeat)
         self._last_stats_log = float("-inf")

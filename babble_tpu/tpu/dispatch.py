@@ -70,14 +70,28 @@ class _AsyncPass:
 
     def _run(self, mesh, grid) -> None:
         try:
+            from .doubling import use_doubling
             from .engine import _frontier_safe
-            from .sharded import sharded_frontier_passes, sharded_run_passes
+            from .grid import GridUnsupported
+            from .sharded import (
+                sharded_doubling_passes,
+                sharded_frontier_passes,
+                sharded_run_passes,
+            )
 
             with _MESH_EXEC_LOCK:
-                if _frontier_safe(grid):
-                    self.value = sharded_frontier_passes(mesh, grid)
-                else:
-                    self.value = sharded_run_passes(mesh, grid)
+                if use_doubling(grid):
+                    # deep section: log-diameter cold path; anything its
+                    # kernels cannot certify falls down the resident ladder
+                    try:
+                        self.value = sharded_doubling_passes(mesh, grid)
+                    except GridUnsupported:
+                        self.value = None
+                if self.value is None:
+                    if _frontier_safe(grid):
+                        self.value = sharded_frontier_passes(mesh, grid)
+                    else:
+                        self.value = sharded_run_passes(mesh, grid)
         except BaseException as e:  # noqa: BLE001 — surfaced in result()
             self.error = e
         finally:

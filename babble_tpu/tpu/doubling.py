@@ -1,0 +1,755 @@
+"""Log-diameter cold path: pointer-doubling ancestry closure + contracted
+frontier walk for deep DAG sections.
+
+Every other device engine pays a sequential loop linear in DAG extent: the
+frontier walk (frontier.py) runs one step per ROUND, the level scan
+(kernels.py) one step per LEVEL — and recovery, fast-sync section replay
+and cold batch ingest are exactly the workloads that arrive thousands of
+rounds deep (ROADMAP item 2). This module replays such a section in
+O(log depth) device passes, following the pointer-doubling / graph-
+contraction recipe of "Parallel Graph Connectivity in Log Diameter Rounds"
+(PAPERS.md):
+
+1. **Ancestry closure by squaring** (`_closure_la`): starting from the
+   self-parent/other-parent successor tables staged in `DagGrid`, each
+   pass (a) closes every self-chain by a prefix-max shift cascade
+   (gathers at offsets 1, 2, 4, ... — chains compose for free), then
+   (b) squares cross-chain reachability: every event jumps to the latest
+   recorded ancestor on each chain and absorbs THAT event's coordinate
+   vector. Step (a) keeps the iterate chain-monotone, which is what makes
+   the textbook midpoint induction go through: after pass k the iterate
+   covers every ancestor within 2^k other-parent edges (self-parent runs
+   are free), so ceil(log2 depth)+1 passes reach the fixpoint — the exact
+   `lastAncestors` matrix. Everything is batched gathers / max-reductions;
+   no data-dependent scatter. The result is checked against the staged
+   coordinates (a non-section-closed store raises `GridUnsupported` and
+   the caller's ladder falls back).
+
+2. **Contracted frontier walk** (`_walk_chunk`): the round frontier
+   history X(0..R) is the one truly sequential recurrence left. The walk
+   is dispatched in geometrically growing chunks (16, 32, 64, ...), so
+   the DISPATCH count is <= log2(R)+c — overshoot past the fixpoint is
+   harmless because the transition is exact and saturating. Within a
+   step, the settled prefix is contracted away: the strongly-seeing
+   binary search starts at the current frontier (its result provably
+   cannot lie below it) and its probe count shrinks as the un-walked
+   interval shrinks; the cross-chain closure and witness coordinate rows
+   are direct int32 INV gathers (N^2-sized) instead of the one-hot
+   N^2*L einsums of the per-round walk — the per-step cost no longer
+   scales with chain length, which is where the deep-section speedup
+   comes from.
+
+3. **Seeded sections** (post-reset / fast-sync frames): external parent
+   metadata (`fixed_round`/`ext_*_round`) enters the walk as a per-round
+   seed table S[r, c] = first chain-c index whose ancestry certifies
+   round >= r (a prefix-max over origin seeds pushed through the closed
+   coordinates, then one searchsorted per chain). Chain indexes are
+   rebased per chain so a section that starts mid-history walks in local
+   coordinates. Witnesses are recomputed from the scan's own rule
+   (round(e) > round(self-parent)), never from frontier movement — a
+   seed-pulled frontier row need not be a witness. This replaces the
+   level-scan fallback that made post-reset the slowest path.
+
+Fame and round-received run unchanged on the existing kernels
+(`kernels._decide_fame` / `_decide_round_received`) over a host-assembled
+witness table — the CPU hashgraph engine stays the differential oracle
+(tests/test_doubling.py asserts byte-identity against the level scan and
+the frontier walk on every fixture, including post-reset sections, before
+any timing; bench_catchup.py re-asserts it before its headline).
+
+Total measured device pass count: closure passes (<= log2 depth + 2)
++ walk dispatches (<= log2 rounds + c) + 1 fame/received dispatch —
+asserted logarithmic in bench_catchup.py and tests/test_doubling.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import PassResults, _bucket, _frontier_safe, pad_grid, rebase_rounds
+from .frontier import build_inv, level_lamport
+from .grid import DagGrid, GridUnsupported, MAX_INT32, MIN_INT32
+from .kernels import _decide_fame, _decide_round_received
+
+# ---------------------------------------------------------------------------
+# crossover selection (engine ladder)
+# ---------------------------------------------------------------------------
+
+# depth (topological levels) above which the cold path beats the resident
+# engines: the frontier walk keeps per-step cost ~N^2*L (the one-hot INV
+# einsums grow with chain length), the level scan pays one step per level.
+# Defaults measured on the CPU backend; BABBLE_DOUBLING_CROSSOVER overrides
+# with a number (both paths) or "auto" (one-shot timing probe).
+_CROSSOVER_BASE = 1024
+_CROSSOVER_SEEDED = 192
+
+_calibrated: Optional[tuple] = None
+
+
+def calibrate_crossover() -> tuple:
+    """One-shot probe: time the frontier walk against the doubling path on
+    a small deep synthetic grid and place the base crossover on the
+    winning side; the seeded crossover scales down by the measured
+    level-scan handicap (the fallback it replaces is far slower). Cached
+    for the process — a tier-1 run never triggers this (env unset uses
+    the static defaults)."""
+    import time
+
+    from .engine import run_frontier_passes
+    from .grid import synthetic_deep_grid
+
+    g = synthetic_deep_grid(8, 512, seed=0, zipf_a=1.2)
+    run_frontier_passes(g)  # compile
+    t0 = time.perf_counter()
+    run_frontier_passes(g)
+    t_fr = time.perf_counter() - t0
+    run_doubling_passes(g)
+    t0 = time.perf_counter()
+    run_doubling_passes(g)
+    t_dbl = time.perf_counter() - t0
+    base = 512 if t_dbl < t_fr else 2048
+    base = min(max(base, 128), 4096)
+    seeded = min(max(base // 4, 64), 1024)
+    return base, seeded
+
+
+def doubling_crossover(seeded: bool) -> int:
+    """Depth threshold for routing a grid onto the doubling cold path."""
+    global _calibrated
+    env = os.environ.get("BABBLE_DOUBLING_CROSSOVER", "").strip()
+    if env and env != "auto":
+        try:
+            return max(int(env), 1)
+        except ValueError:
+            pass
+    if env == "auto":
+        if _calibrated is None:
+            _calibrated = calibrate_crossover()
+        return _calibrated[1] if seeded else _calibrated[0]
+    return _CROSSOVER_SEEDED if seeded else _CROSSOVER_BASE
+
+
+def use_doubling(grid: DagGrid) -> bool:
+    """Ladder predicate: deep enough that log-diameter passes win."""
+    if grid.e == 0:
+        return False
+    return grid.num_levels >= doubling_crossover(not _frontier_safe(grid))
+
+
+# ---------------------------------------------------------------------------
+# pass 1a: pointer-doubling lastAncestors closure
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("l", "block", "pass_cap")
+)
+def _closure_la(creator, index, sp, op, rows_by, l: int, block: int,
+                pass_cap: int):
+    """Close lastAncestors from the parent successor tables by repeated
+    squaring; returns (la, passes). All coordinates are per-chain indexes
+    (rebased for sections); padded rows carry index -1 and stay inert.
+
+    Each pass is (a) a self-chain prefix-max via shift-doubling gathers —
+    restores chain monotonicity, which squaring breaks — then (b) one
+    cross-chain squaring: jump to the recorded latest ancestor on every
+    chain and absorb its vector. The squaring gather is chunked over the
+    event axis (lax.map) to bound the (block, N, N) transient."""
+    e = creator.shape[0]
+    n = rows_by.shape[0]
+    rb = jnp.maximum(rows_by, 0)
+    cols = jnp.arange(n)[None, :]
+
+    # init: own coordinate + both parents' own coordinates (1-hop)
+    own = jnp.where(
+        (cols == creator[:, None]) & (index[:, None] >= 0),
+        index[:, None], -1,
+    )
+    sp_c = creator[jnp.maximum(sp, 0)]
+    sp_i = index[jnp.maximum(sp, 0)]
+    la0 = jnp.maximum(
+        own,
+        jnp.where((sp >= 0)[:, None] & (cols == sp_c[:, None]),
+                  sp_i[:, None], -1),
+    )
+    op_c = creator[jnp.maximum(op, 0)]
+    op_i = index[jnp.maximum(op, 0)]
+    la0 = jnp.maximum(
+        la0,
+        jnp.where((op >= 0)[:, None] & (cols == op_c[:, None]),
+                  op_i[:, None], -1),
+    )
+
+    def chain_prefix(la):
+        # prefix-max along every self-chain, in chain-table layout: one
+        # gather out to (N, L, N), an inclusive max-scan down the index
+        # axis (log2(l) internal steps), one gather back. A shift-doubling
+        # gather CHAIN computes the same thing but is quadratic-recompute
+        # bait for XLA:CPU's gather fusion (measured 473 ms vs 0.5 ms at
+        # l=4096); the scan keeps every step a sliced elementwise max.
+        lat = jnp.where((rows_by >= 0)[:, :, None], la[rb], -1)
+        lat = jax.lax.associative_scan(jnp.maximum, lat, axis=1)
+        return jnp.where(
+            (index >= 0)[:, None],
+            lat[creator, jnp.clip(index, 0, l - 1)], la,
+        )
+
+    nb = e // block
+
+    def square(la):
+        def blk(la_blk):
+            tgt = rb[cols, jnp.clip(la_blk, 0, l - 1)]  # (block, n) rows
+            contrib = la[tgt]  # (block, n, n)
+            contrib = jnp.where((la_blk >= 0)[:, :, None], contrib, -1)
+            return jnp.maximum(la_blk, jnp.max(contrib, axis=1))
+
+        return jax.lax.map(blk, la.reshape(nb, block, n)).reshape(e, n)
+
+    def cond(carry):
+        _, passes, changed = carry
+        return changed & (passes < pass_cap)
+
+    def body(carry):
+        la, passes, _ = carry
+        la2 = square(chain_prefix(la))
+        return la2, passes + 1, jnp.any(la2 != la)
+
+    la_fin, passes, _ = jax.lax.while_loop(
+        cond, body, (la0, jnp.int32(0), jnp.bool_(True))
+    )
+    return la_fin, passes
+
+
+# ---------------------------------------------------------------------------
+# pass 1b: contracted frontier walk
+# ---------------------------------------------------------------------------
+
+
+def _m0_binsearch_from(fd_w, w_ok, rb, chain_len, la, lo0,
+                       super_majority: int, l: int, steps: int):
+    """frontier._m0_binsearch with a per-chain lower bound: the first
+    index strongly seeing the round-r frontier has round >= r+1, hence
+    index >= X(r) — so the settled prefix [0, X(r)) is contracted out of
+    the search interval and `steps` (host-chosen from the widest remaining
+    interval) shrinks as the walk advances. Identical results: the
+    predicate is monotone and the true answer never lies below lo0."""
+    n = rb.shape[0]
+    sent = jnp.int32(l)
+    cc = jnp.arange(n)
+    last = jnp.maximum(chain_len - 1, 0)
+
+    lo = jnp.clip(lo0, 0, l)
+    hi = jnp.full((n,), l, jnp.int32)
+    for _ in range(steps):
+        mid = jnp.minimum((lo + hi) // 2, l - 1)
+        probe = jnp.minimum(mid, last)
+        ev = rb[cc, probe]
+        la_mid = la[ev]
+        cnt_p = jnp.sum(
+            la_mid[:, None, :] >= fd_w[None, :, :], axis=-1, dtype=jnp.int32
+        )
+        sees = (cnt_p >= super_majority) & w_ok[None, :]
+        pred = (
+            (jnp.sum(sees, axis=1, dtype=jnp.int32) >= super_majority)
+            & (chain_len > 0)
+        )
+        hi = jnp.where(pred, jnp.minimum(mid, hi), hi)
+        lo = jnp.where(pred, lo, mid + 1)
+    return jnp.where(hi < chain_len, hi, sent)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("super_majority", "l", "length", "steps", "use_seeds"),
+)
+def _walk_chunk(inv_i32, rows_by, fd, la, x0, seeds, r_abs, first_nw,
+                super_majority: int, l: int, length: int, steps: int,
+                use_seeds: bool):
+    """`length` frontier transitions in one dispatch, emitting
+    X(r+1)..X(r+length). The per-step closure and witness-row coordinate
+    lookups are direct int32 gathers from INV (values < 2^24, exact), so
+    a step costs O(N^2 + N^3*steps) independent of chain length. Seeds
+    (post-reset round anchors) enter as a min against the per-round seed
+    row; the clamp keeps the history monotone either way.
+
+    first_nw masks the one seeded-grid case where a frontier row is NOT
+    countable: a chain-first section row whose round equals its external
+    self-parent round is a round-r frontier row but not a witness (the
+    scan's strongly-see count runs over wtable), and counting it could
+    certify an increment the scan does not grant. The mask is exact: when
+    it fires, that chain provably has no round-r witness at all (any later
+    exact-round-r event inherits sp_round == r). Every other frontier row
+    is either a true round-r witness or has round >= r+1, which ancestry
+    alone certifies (frontier.py structural fact 3)."""
+    n = rows_by.shape[0]
+    sent = jnp.int32(l)
+    rb = jnp.maximum(rows_by, 0)
+    cc = jnp.arange(n)
+    chain_len = jnp.sum(rows_by >= 0, axis=1).astype(jnp.int32)
+
+    def step(x_cur, xs):
+        s_row, r_cur = xs
+        w_ok = x_cur < sent
+        if use_seeds:
+            w_ok = w_ok & ~((x_cur == 0) & (r_cur == first_nw))
+        w_row = rb[cc, jnp.clip(x_cur, 0, l - 1)]
+        fd_w = jnp.where(w_ok[:, None], fd[w_row], MAX_INT32)
+        m0 = _m0_binsearch_from(
+            fd_w, w_ok, rb, chain_len, la, x_cur, super_majority, l, steps
+        )
+        # cross-chain closure: reach[c, x] = INV[c, x, m0[x]]
+        reach = inv_i32[:, cc, jnp.clip(m0, 0, l - 1)]
+        reach = jnp.where((m0 < sent)[None, :], reach, sent)
+        x_next = jnp.minimum(m0, jnp.min(reach, axis=1))
+        if use_seeds:
+            x_next = jnp.minimum(x_next, s_row)
+        x_next = jnp.minimum(jnp.maximum(x_next, x_cur), sent)
+        return x_next, x_next
+
+    x_last, xs = jax.lax.scan(step, x0, (seeds, r_abs), length=length)
+    return x_last, xs
+
+
+_WALK_CHUNK0 = 16
+_WALK_CHUNK_MAX = 4096
+
+
+def _doubling_walk(put, inv_i32, rows_by_d, fd_d, la_d, x0, s_np, first_nw,
+                   super_majority: int, l: int, use_seeds: bool,
+                   stats: dict) -> np.ndarray:
+    """Host driver: geometric chunk growth keeps the dispatch count
+    logarithmic in the round count; the walk stops once the frontier is
+    fully saturated or stalled with no seed rounds left (a stalled
+    transition is a fixpoint of the exact per-round map). Returns the
+    (R+1, N) frontier history X(0..R)."""
+    n = x0.shape[0]
+    r_seed_max = s_np.shape[0] - 1 if use_seeds else -1
+    first_nw_d = put(first_nw)
+    x_cur = x0
+    rows = [x0[None, :]]
+    r_done = 0
+    chunk = _WALK_CHUNK0
+    chunks = 0
+    full_steps = max(1, (l - 1).bit_length()) + 1
+    # walk length is bounded by the chain axis plus the seed span: every
+    # non-stalled round advances some chain, and stalls only happen under
+    # pending seed rounds
+    cap = l + max(r_seed_max, 0) + 8
+    while True:
+        seg = np.full((chunk, n), l, dtype=np.int32)
+        if use_seeds:
+            lo_r = r_done + 1
+            hi_r = min(lo_r + chunk, s_np.shape[0])
+            if hi_r > lo_r:
+                seg[: hi_r - lo_r] = s_np[lo_r:hi_r]
+        # contraction: probe count from the widest un-settled interval,
+        # bucketed to multiples of 4 to bound recompiles
+        rem = max(l - int(x_cur.min()), 1)
+        steps = min(-(-(rem.bit_length() + 1) // 4) * 4, full_steps)
+        r_vec = (r_done + np.arange(chunk)).astype(np.int32)
+        x_last_d, xs_d = _walk_chunk(
+            inv_i32, rows_by_d, fd_d, la_d, put(x_cur), put(seg), put(r_vec),
+            first_nw_d, super_majority, l, chunk, steps, use_seeds,
+        )
+        xs = np.asarray(xs_d)
+        x_last = np.asarray(x_last_d)
+        rows.append(xs)
+        chunks += 1
+        r_done += chunk
+        stalled = bool((x_last == x_cur).all())
+        x_cur = x_last
+        if bool((x_last >= l).all()):
+            break
+        if stalled and r_done > r_seed_max:
+            break
+        if r_done > cap:
+            raise GridUnsupported("doubling walk failed to converge")
+        chunk = min(chunk * 2, _WALK_CHUNK_MAX)
+    stats["walk_chunks"] = chunks
+    return np.concatenate(rows, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# passes 2+3 (single-device): existing fame/received kernels
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("super_majority", "n_participants", "d_cap")
+)
+def _fame_received(wtable, la, fd, index, creator, coin, rounds, last_round,
+                   super_majority: int, n_participants: int, d_cap: int):
+    fame = _decide_fame(
+        wtable, la, fd, index, coin, last_round,
+        super_majority, n_participants, d_cap,
+    )
+    received = _decide_round_received(
+        wtable, la, index, creator, rounds,
+        fame.decided, fame.famous, fame.rounds_decided, last_round,
+    )
+    return fame.decided, fame.famous, fame.rounds_decided, received
+
+
+# ---------------------------------------------------------------------------
+# host staging
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _lamport_levels_scan(levels, sp, op, esp, eop, fpin):
+    """Device lamport recurrence over the level table: the scan step is
+    the lamport slice of kernels._divide_rounds, nothing else — lamport
+    is a longest-path quantity and does not decompose through ancestor
+    jumps, so the cold path keeps the level-sequential scan but sheds the
+    per-level host dispatch (a host numpy loop costs ~25us/level; deep
+    sections have tens of thousands of levels)."""
+    e = sp.shape[0]
+
+    def step(lam, rows):
+        valid = rows >= 0
+        r = jnp.maximum(rows, 0)
+        s, o = sp[r], op[r]
+        sl = jnp.where(s >= 0, lam[jnp.maximum(s, 0)], esp[r])
+        ol = jnp.where(o >= 0, lam[jnp.maximum(o, 0)], eop[r])
+        v = jnp.maximum(sl, ol) + 1
+        pin = fpin[r]
+        v = jnp.where(pin != MIN_INT32, pin, v)
+        tgt = jnp.where(valid, r, e)  # padding lanes dropped out of bounds
+        return lam.at[tgt].set(v, mode="drop"), None
+
+    lam0 = jnp.zeros((e,), jnp.int32)
+    lam, _ = jax.lax.scan(step, lam0, levels)
+    return lam
+
+
+def seeded_lamport(grid: DagGrid) -> np.ndarray:
+    """(E,) lamport timestamps replicating the level scan's recurrence on
+    seeded grids (external parent lamports + pinned overrides), computed
+    as one compiled device scan over the level table. Shapes are bucketed
+    (levels axis and event axis, both power-of-two schedules) so a replay
+    ladder probing nearby depths triggers only O(log depth) compiles."""
+    lev_b = _bucket(grid.num_levels, 64, factor=2)
+    levels = np.full((lev_b, grid.levels.shape[1]), -1, dtype=np.int32)
+    levels[: grid.num_levels] = grid.levels[: grid.num_levels]
+    e_b = _bucket(grid.e, 256)
+    pad_e = e_b - grid.e
+    lam = _lamport_levels_scan(
+        jnp.asarray(levels),
+        jnp.asarray(_pad1(grid.self_parent, pad_e, -1)),
+        jnp.asarray(_pad1(grid.other_parent, pad_e, -1)),
+        jnp.asarray(_pad1(grid.ext_sp_lamport, pad_e, -1)),
+        jnp.asarray(_pad1(grid.ext_op_lamport, pad_e, MIN_INT32)),
+        jnp.asarray(_pad1(grid.fixed_lamport, pad_e, MIN_INT32)),
+    )
+    return np.asarray(lam)[: grid.e]
+
+
+def _seed_table(creator, idx_rb, la_rb, oseed, chain_len, n: int, l: int):
+    """S[r, c] = first chain-c (rebased) index whose ancestry certifies
+    round >= r, from the per-event origin seeds (fixed/external rounds).
+
+    aseed(e) = max(oseed(e), max_p M[p, la(e, p)]) where M is the
+    per-chain prefix-max of oseed — sound (ancestor round facts transfer
+    up by round monotonicity along ancestry) and non-decreasing along
+    every chain (la is chain-monotone), so one searchsorted per chain
+    inverts it into the round-indexed table the walk consumes."""
+    m = np.full((n, l), -1, dtype=np.int64)
+    m[creator, idx_rb] = oseed
+    np.maximum.accumulate(m, axis=1, out=m)
+    lap = np.clip(la_rb, 0, l - 1)
+    contrib = m[np.arange(n)[None, :], lap]  # (E, N)
+    contrib = np.where(la_rb >= 0, contrib, -1)
+    aseed = np.maximum(oseed, contrib.max(axis=1, initial=-1))
+
+    r_seed_max = int(aseed.max(initial=-1))
+    if r_seed_max < 0:
+        return np.full((1, n), l, dtype=np.int32)
+    a = np.full((n, l), np.iinfo(np.int64).max, dtype=np.int64)
+    a[creator, idx_rb] = aseed
+    s = np.full((r_seed_max + 2, n), l, dtype=np.int32)
+    rr = np.arange(r_seed_max + 2)
+    for c in range(n):
+        ln = int(chain_len[c])
+        if ln == 0:
+            continue
+        pos = np.searchsorted(a[c, :ln], rr, side="left")
+        s[:, c] = np.where(pos < ln, pos, l).astype(np.int32)
+    return s
+
+
+def _chain_layout(grid: DagGrid):
+    """Per-chain index rebasing + structural guards. Returns
+    (chain_min, idx_rb, chain_len); raises GridUnsupported on forks,
+    duplicate coordinates or non-contiguous chains (the closure and the
+    searchsorted seed inversion both rely on chains being contiguous
+    suffixes of their history)."""
+    n, e = grid.n, grid.e
+    creator = grid.creator
+    index = grid.index.astype(np.int64)
+    chain_min = np.full(n, MAX_INT32, dtype=np.int64)
+    np.minimum.at(chain_min, creator, index)
+    chain_max = np.full(n, -1, dtype=np.int64)
+    np.maximum.at(chain_max, creator, index)
+    counts = np.bincount(creator, minlength=n)
+    nonempty = counts > 0
+    chain_min[~nonempty] = 0
+    if not bool(
+        (chain_max[nonempty] - chain_min[nonempty] + 1
+         == counts[nonempty]).all()
+    ):
+        raise GridUnsupported("doubling: non-contiguous chain indexes")
+    pairs = creator.astype(np.int64) * (int(index.max(initial=0)) + 2) + index
+    if np.unique(pairs).size != e:
+        raise GridUnsupported("doubling: duplicate (creator, index) rows")
+    idx_rb = (index - chain_min[creator]).astype(np.int32)
+    return chain_min, idx_rb, counts.astype(np.int32)
+
+
+def _pad1(a: np.ndarray, pad: int, fill) -> np.ndarray:
+    if pad == 0:
+        return a
+    return np.concatenate([a, np.full(pad, fill, dtype=a.dtype)])
+
+
+def _doubling_stage1(grid: DagGrid, put, stats: dict):
+    """Pass 1 of the cold path, host-orchestrated: closure + contracted
+    walk + witness/round assembly. `put` places device inputs (identity
+    jax.device_put for the single-device path; a replicated NamedSharding
+    put for the mesh variant, keeping the work off the default backend).
+
+    Returns (grid_rb, offset, rounds_np, witness_np, lamport_np,
+    wtable_np, last_round) — rounds/last_round on the rebased round axis,
+    wtable rows indexed by round - offset (the PassResults contract)."""
+    if grid.e == 0:
+        raise GridUnsupported("doubling: empty grid")
+    e_real, n = grid.e, grid.n
+    grid_rb, offset = rebase_rounds(grid)
+    seeded = not _frontier_safe(grid)
+
+    chain_min, idx_rb, chain_len = _chain_layout(grid)
+    # the walk starts at round 0: every chain-first event must carry a
+    # round anchor (genesis pin or external-parent metadata)
+    first_rows = grid.index.astype(np.int64) == chain_min[grid.creator]
+    anchored = (
+        (grid_rb.fixed_round >= 0)
+        | (grid_rb.ext_sp_round >= 0)
+        | (grid_rb.ext_op_round >= 0)
+    )
+    if not bool(anchored[first_rows].all()):
+        raise GridUnsupported("doubling: unanchored chain-first event")
+
+    # rebase every per-chain coordinate into section-local space; an
+    # ancestor below the section floor has no in-section coordinate (-1)
+    la64 = grid.last_ancestors.astype(np.int64) - chain_min[None, :]
+    la_rb = np.where(grid.last_ancestors >= 0, la64, -1)
+    la_rb = np.where(la_rb >= 0, la_rb, -1).astype(np.int32)
+    fd64 = grid.first_descendants.astype(np.int64) - chain_min[None, :]
+    fd_rb = np.where(grid.first_descendants == MAX_INT32, MAX_INT32, fd64)
+    if bool((fd_rb < 0).any()):
+        raise GridUnsupported("doubling: first descendant below section")
+    fd_rb = fd_rb.astype(np.int32)
+
+    l_real = int(idx_rb.max(initial=0)) + 1
+    l_b = _bucket(l_real, 64, factor=2)
+    rows_by = np.full((n, l_b), -1, dtype=np.int32)
+    rows_by[grid.creator, idx_rb] = np.arange(e_real, dtype=np.int32)
+
+    e_b = _bucket(e_real, 256)
+    pad_e = e_b - e_real
+    idx_p = _pad1(idx_rb, pad_e, -1)
+    creator_p = _pad1(grid.creator, pad_e, 0)
+    sp_p = _pad1(grid.self_parent, pad_e, -1)
+    op_p = _pad1(grid.other_parent, pad_e, -1)
+    la_p = np.concatenate(
+        [la_rb, np.full((pad_e, n), -1, dtype=np.int32)]
+    ) if pad_e else la_rb
+    fd_p = np.concatenate(
+        [fd_rb, np.full((pad_e, n), MAX_INT32, dtype=np.int32)]
+    ) if pad_e else fd_rb
+
+    rows_by_d = put(rows_by)
+    la_d = put(la_p)
+    creator_d = put(creator_p)
+    idx_d = put(idx_p)
+
+    # closure: squares reachability per pass; block bounds the squaring
+    # transient at block*N*N (e_b and the cap are both powers of two
+    # times 256, so the block always divides the padded event axis)
+    block = min(e_b, max(256, min(2048, (1 << 24) // max(n * n, 1))))
+    block = 1 << (block.bit_length() - 1)
+    pass_cap = max(l_b.bit_length(), 1) + 4
+    la_closed_d, passes_d = _closure_la(
+        creator_d, idx_d, put(sp_p), put(op_p), rows_by_d,
+        l_b, block, pass_cap,
+    )
+    closure_passes = int(np.asarray(passes_d))
+    stats["closure_passes"] = closure_passes
+    if not bool((np.asarray(la_closed_d)[:e_real] == la_rb).all()):
+        # staged coordinates disagree with in-section reachability: the
+        # section is not ancestry-closed (or the store is corrupt) — the
+        # ladder falls back to a path that does not jump through la
+        raise GridUnsupported("doubling: closure/staged ancestor mismatch")
+
+    inv_i32 = build_inv(rows_by_d, la_d).astype(jnp.int32)
+
+    first_nw = np.full(n, -1, dtype=np.int32)
+    if seeded:
+        oseed = np.maximum.reduce([
+            grid_rb.fixed_round.astype(np.int64),
+            grid_rb.ext_sp_round.astype(np.int64),
+            grid_rb.ext_op_round.astype(np.int64),
+        ])
+        s_np = _seed_table(
+            grid.creator, idx_rb, la_rb, oseed, chain_len, n, l_b
+        )
+        # chain-first rows can be non-witness frontier rows (see
+        # _walk_chunk): the round at which that happens is knowable ahead
+        # of the walk — a pinned round <= the external self-parent round,
+        # or exactly the external self-parent round when unpinned
+        fr = rows_by[:, 0]
+        ne = fr >= 0
+        fx = grid_rb.fixed_round[fr[ne]]
+        es = grid_rb.ext_sp_round[fr[ne]]
+        first_nw[ne] = np.where(fx >= 0, np.where(fx <= es, fx, -1), es)
+    else:
+        s_np = np.full((1, n), l_b, dtype=np.int32)
+
+    x0 = np.where(rows_by[:, 0] >= 0, 0, l_b).astype(np.int32)
+    x_hist = _doubling_walk(
+        put, inv_i32, rows_by_d, put(fd_p), la_d, x0, s_np, first_nw,
+        grid.super_majority, l_b, seeded, stats,
+    )
+
+    # rounds from the frontier history: X(:, c) is non-decreasing, so
+    # round(e) = |{r : idx(e) >= X(r)[c]}| - 1 is one searchsorted per
+    # chain (host, O(E log R))
+    rounds_np = np.full(e_real, -1, dtype=np.int32)
+    for c in range(n):
+        ch = rows_by[c, : chain_len[c]]
+        if ch.size == 0:
+            continue
+        rounds_np[ch] = (
+            np.searchsorted(x_hist[:, c], idx_rb[ch], side="right") - 1
+        )
+    rounds_np = np.where(
+        grid_rb.fixed_round[:e_real] >= 0, grid_rb.fixed_round[:e_real],
+        rounds_np,
+    ).astype(np.int32)
+    if bool((rounds_np < 0).any()):
+        raise GridUnsupported("doubling: walk left events unrounded")
+
+    # the scan's witness rule, verbatim: round(e) > round(self-parent)
+    sp = grid.self_parent
+    sp_round = np.where(
+        sp >= 0, rounds_np[np.maximum(sp, 0)], grid_rb.ext_sp_round[:e_real]
+    )
+    witness_np = rounds_np > sp_round
+
+    last_round = int(rounds_np.max(initial=0))
+    r_rows = _bucket(last_round + 4, 64, factor=2)
+    w = np.nonzero(witness_np)[0]
+    wtable_np = np.full((r_rows, n), -1, dtype=np.int32)
+    wtable_np[rounds_np[w], grid.creator[w]] = w.astype(np.int32)
+    if int((wtable_np >= 0).sum()) != w.size:
+        raise GridUnsupported("doubling: colliding witness coordinates")
+
+    lamport_np = (
+        seeded_lamport(grid) if seeded else level_lamport(grid)
+    )
+    stats["depth"] = int(grid.num_levels)
+    stats["rounds"] = last_round
+    return (
+        grid_rb, offset, rounds_np, witness_np, lamport_np, wtable_np,
+        last_round,
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine entry points
+# ---------------------------------------------------------------------------
+
+
+def run_doubling_passes(
+    grid: DagGrid, d_max: Optional[int] = None, stats: Optional[dict] = None,
+) -> PassResults:
+    """Full three-pass cold-path pipeline on the default device; same
+    PassResults contract as run_passes/run_frontier_passes. Raises
+    GridUnsupported on anything the doubling kernels cannot certify
+    (callers fall back down the ladder)."""
+    st = stats if stats is not None else {}
+    (grid_rb, offset, rounds_np, witness_np, lamport_np, wtable_np,
+     last_round) = _doubling_stage1(grid, jax.device_put, st)
+
+    e_real = grid.e
+    grid_p = pad_grid(grid_rb)
+    rounds_p = _pad1(rounds_np, grid_p.creator.shape[0] - e_real, -1)
+    d_cap = d_max if d_max is not None else wtable_np.shape[0] + 2
+    decided_d, famous_d, rdec_d, received_d = _fame_received(
+        jax.device_put(wtable_np), jax.device_put(grid_p.last_ancestors),
+        jax.device_put(grid_p.first_descendants),
+        jax.device_put(grid_p.index), jax.device_put(grid_p.creator),
+        jax.device_put(grid_p.coin_bit), jax.device_put(rounds_p),
+        jnp.int32(last_round), grid.super_majority, grid.n, d_cap,
+    )
+    received = np.asarray(received_d)[:e_real]
+    st["passes"] = st.get("closure_passes", 0) + st.get("walk_chunks", 0) + 1
+
+    rounds = rounds_np
+    if offset:
+        rounds = np.where(rounds >= 0, rounds + offset, rounds)
+        received = np.where(received >= 0, received + offset, received)
+    return PassResults(
+        rounds=rounds.astype(np.int32),
+        witness=np.asarray(witness_np),
+        lamport=lamport_np,
+        witness_table=wtable_np,
+        fame_decided=np.asarray(decided_d),
+        famous=np.asarray(famous_d),
+        rounds_decided=np.asarray(rdec_d),
+        received=received.astype(np.int32),
+        last_round=last_round + offset,
+        round_offset=offset,
+    )
+
+
+def maybe_cold_replay(hg, grid: DagGrid) -> bool:
+    """Live-engine bootstrap hook: replay a deep/post-reset grid through
+    the cold path and stamp its results into the store, so the frontier
+    attach that follows only carries the unsettled tail. Returns False
+    (and leaves no trace) when the grid is shallow or unsupported."""
+    if not use_doubling(grid):
+        return False
+    from .engine import integrate_pass_results
+
+    clock = hg.obs.clock
+    t0 = clock.monotonic()
+    st: dict = {}
+    try:
+        res = run_doubling_passes(grid, stats=st)
+    except GridUnsupported:
+        return False
+    integrate_pass_results(hg, grid, res)
+    dt = clock.monotonic() - t0
+    observe_catchup(hg.obs, st, dt)
+    return True
+
+
+def observe_catchup(obs, stats: dict, seconds: float) -> None:
+    """Shared cold-path telemetry: the replay histogram the catchup_replay
+    SLO objective evaluates, plus the flight-recorder record."""
+    obs.histogram(
+        "babble_catchup_replay_seconds",
+        "Cold-path (pointer-doubling) section replay wall time",
+    ).observe(seconds)
+    obs.flightrec.record(
+        "catchup.replay",
+        depth=int(stats.get("depth", 0)),
+        passes=int(stats.get("passes", 0)),
+        ms=round(seconds * 1e3, 3),
+    )

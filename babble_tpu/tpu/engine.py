@@ -471,6 +471,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         labels=("path",),
     )
     if mesh is not None:
+        from .doubling import observe_catchup, sharded_doubling_passes, use_doubling
         from .dispatch import _MESH_EXEC_LOCK
         from .sharded import sharded_frontier_passes, sharded_run_passes
 
@@ -479,24 +480,52 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         # otherwise interleave collectives with this program and
         # deadlock the mesh (tpu/dispatch.py _MESH_EXEC_LOCK)
         _t1 = clock.monotonic()
+        _dbl_stats = None
         with _MESH_EXEC_LOCK:
-            if _frontier_safe(grid):
-                res = sharded_frontier_passes(mesh, grid)
-            else:
-                res = sharded_run_passes(mesh, grid)
-        _m_run.labels(path="mesh").observe(clock.monotonic() - _t1)
+            res = None
+            if use_doubling(grid):
+                # deep section: the log-diameter cold path, sharded
+                _dbl_stats = {}
+                try:
+                    res = sharded_doubling_passes(mesh, grid, stats=_dbl_stats)
+                except GridUnsupported:
+                    res, _dbl_stats = None, None
+            if res is None:
+                if _frontier_safe(grid):
+                    res = sharded_frontier_passes(mesh, grid)
+                else:
+                    res = sharded_run_passes(mesh, grid)
+        _run_s = clock.monotonic() - _t1
+        _m_run.labels(path="mesh").observe(_run_s)
+        if _dbl_stats is not None:
+            observe_catchup(obs, _dbl_stats, _run_s)
         obs.gauge(
             "babble_mesh_staged_events",
             "Events staged onto the mesh in the latest mesh call",
         ).set(grid.e)
-    elif _frontier_safe(grid):
-        _t1 = clock.monotonic()
-        res = run_frontier_passes(grid, d_max=d_max)
-        _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
     else:
-        _t1 = clock.monotonic()
-        res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
-        _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
+        from .doubling import observe_catchup, run_doubling_passes, use_doubling
+
+        res = None
+        if use_doubling(grid):
+            _t1 = clock.monotonic()
+            _dbl_stats = {}
+            try:
+                res = run_doubling_passes(grid, d_max=d_max, stats=_dbl_stats)
+            except GridUnsupported:
+                res = None
+            if res is not None:
+                _run_s = clock.monotonic() - _t1
+                _m_run.labels(path="oneshot").observe(_run_s)
+                observe_catchup(obs, _dbl_stats, _run_s)
+        if res is None and _frontier_safe(grid):
+            _t1 = clock.monotonic()
+            res = run_frontier_passes(grid, d_max=d_max)
+            _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
+        elif res is None:
+            _t1 = clock.monotonic()
+            res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
+            _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
 
     integrate_pass_results(hg, grid, res)
 

@@ -95,9 +95,11 @@ def level_lamport(grid: DagGrid) -> np.ndarray:
     (valid for base grids, whose external lamport seeds are all absent —
     the insert path maintains this incrementally in a live node)."""
     out = np.zeros(grid.e, dtype=np.int32)
-    for lvl in range(grid.num_levels):
-        rows = grid.levels[lvl]
-        out[rows[rows >= 0]] = lvl
+    levels = grid.levels[: grid.num_levels]
+    mask = levels >= 0
+    out[levels[mask]] = np.broadcast_to(
+        np.arange(grid.num_levels, dtype=np.int32)[:, None], levels.shape
+    )[mask]
     return out
 
 

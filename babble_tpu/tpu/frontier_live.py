@@ -317,3 +317,102 @@ def frontier_multi_train(
 
     out, _ = jax.lax.scan(body, state, stacked)
     return _decide(out, super_majority, n_participants)
+
+
+# ---------------------------------------------------------------------------
+# cold-start bootstrap (log-diameter cold path, tpu/doubling.py)
+# ---------------------------------------------------------------------------
+
+_bootstrap_decide = functools.partial(
+    jax.jit, static_argnames=("super_majority", "n_participants")
+)(_decide)
+
+
+def bootstrap_frontier_state(
+    grid, e_cap: int, l_cap: int, r_cap: int, n_participants: int,
+) -> FrState:
+    """Build a ready FrState for an EXISTING deep base-state DAG without
+    replaying it through append trains: the full frontier history comes
+    from the pointer-doubling cold path (O(log depth) device passes), the
+    INV/chain tables from one build_inv — then a single `_decide` call
+    fills rounds/witness/fame/received from the installed history.
+
+    The installed x_hist is complete and every chain is marked clean, so
+    _decide's warm-start window lands on the sentinel tail and rewrites
+    only sentinel rows; the decision tables are computed over the FULL
+    history exactly as a train replay would have left them
+    (differential-gated in tests/test_doubling.py).
+
+    Raises GridUnsupported for seeded grids (post-reset states carry
+    external round metadata the incremental walk has no seed channel for
+    — those replay through doubling.maybe_cold_replay instead) and for
+    anything that exceeds the state capacities."""
+    from .doubling import _doubling_walk
+    from .engine import _frontier_safe
+    from .frontier import build_inv, level_lamport
+    from .grid import GridUnsupported, MAX_INT32
+
+    n, e = grid.n, grid.e
+    if e == 0 or not _frontier_safe(grid):
+        raise GridUnsupported("frontier bootstrap: empty or seeded grid")
+    if e > e_cap or r_cap < R_WIN:
+        raise GridUnsupported("frontier bootstrap: capacity")
+    l_real = int(grid.index.max(initial=0)) + 1
+    if l_real > l_cap:
+        raise GridUnsupported("frontier bootstrap: chain axis capacity")
+
+    rows_by = np.full((n, l_cap), -1, dtype=np.int32)
+    rows_by[grid.creator, grid.index] = np.arange(e, dtype=np.int32)
+    counts = np.bincount(grid.creator, minlength=n)
+    if not bool(
+        ((np.arange(l_cap)[None, :] < counts[:, None]) == (rows_by >= 0)).all()
+    ):
+        raise GridUnsupported("frontier bootstrap: non-contiguous chains")
+
+    la_np = np.full((e_cap, n), -1, dtype=np.int32)
+    la_np[:e] = grid.last_ancestors
+    fd_np = np.full((e_cap, n), MAX_INT32, dtype=np.int32)
+    fd_np[:e] = grid.first_descendants
+    creator_np = np.zeros(e_cap, dtype=np.int32)
+    creator_np[:e] = grid.creator
+    index_np = np.full(e_cap, -1, dtype=np.int32)
+    index_np[:e] = grid.index
+    lamport_np = np.full(e_cap, -1, dtype=np.int32)
+    lamport_np[:e] = level_lamport(grid)
+    coin_np = np.zeros(e_cap, dtype=bool)
+    coin_np[:e] = grid.coin_bit
+
+    put = jax.device_put
+    rows_by_d = put(rows_by)
+    la_d = put(la_np)
+    inv = build_inv(rows_by_d, la_d)  # (N, N, l_cap) f32
+
+    x0 = np.where(rows_by[:, 0] >= 0, 0, l_cap).astype(np.int32)
+    stats: dict = {}
+    x_hist = _doubling_walk(
+        put, inv.astype(jnp.int32), rows_by_d, put(fd_np), la_d, x0,
+        np.full((1, n), l_cap, dtype=np.int32),
+        np.full(n, -1, dtype=np.int32),
+        grid.super_majority, l_cap, False, stats,
+    )
+    # trim the chunked walk's sentinel overshoot; X rows past the last
+    # round stay at the init sentinel
+    live_rows = int((x_hist < l_cap).any(axis=1).sum())
+    if live_rows + 2 >= r_cap:
+        raise GridUnsupported("frontier bootstrap: round axis capacity")
+    x_np = np.full((r_cap, n), l_cap, dtype=np.int32)
+    x_np[:live_rows] = x_hist[:live_rows]
+
+    state = init_frontier_state(n, e_cap, l_cap, r_cap)
+    state = state._replace(
+        inv=inv,
+        rows_by=rows_by_d,
+        x_hist=put(x_np),
+        la=la_d,
+        creator=put(creator_np),
+        index=put(index_np),
+        lamport=put(lamport_np),
+        coin=put(coin_np),
+        count=jnp.int32(e),
+    )
+    return _bootstrap_decide(state, grid.super_majority, n_participants)

@@ -207,6 +207,13 @@ class LiveDeviceEngine:
             (grid.ext_sp_round == -1).all() and (grid.ext_op_round == -1).all()
         )
         if not base_state or grid.e > self.e_win:
+            # deep or post-reset history: settle it through the
+            # log-diameter cold path first (O(log depth) device passes vs
+            # the store-driven replay's per-round work), so the frontier
+            # attach below only carries the unsettled tail
+            from .doubling import maybe_cold_replay
+
+            maybe_cold_replay(self.hg, grid)
             # capacity for the kept rows is enforced by _install_state
             self._attach_from_frontier()
             return

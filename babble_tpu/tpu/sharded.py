@@ -534,3 +534,58 @@ def sharded_frontier_passes(
         received=received,
         last_round=int(last_round),
     )
+
+
+# ---------------------------------------------------------------------------
+# log-diameter cold path, mesh variant (tpu/doubling.py pass 1)
+# ---------------------------------------------------------------------------
+
+
+def sharded_doubling_passes(
+    mesh: Mesh, grid: DagGrid, chunk: int = 8, stats=None,
+) -> PassResults:
+    """Cold-path pipeline with pass 1 (pointer-doubling closure +
+    contracted walk) running replicated on the mesh devices and passes
+    2+3 riding the shared rounds-/events-sharded fame/received stages —
+    so deep-section mesh catch-up uses the same queued-dispatch rung as
+    the resident pipelines. Results identical to
+    `doubling.run_doubling_passes` (differential-tested).
+
+    Pass 1's device placement goes through a replicated device_put, never
+    the default backend — the multichip dryrun relies on this to stay off
+    the real TPU (same contract as sharded_run_passes)."""
+    from .doubling import _doubling_stage1
+
+    rep = NamedSharding(mesh, P())
+    putr = lambda x: jax.device_put(np.asarray(x), rep)
+    st = stats if stats is not None else {}
+
+    (grid_rb, offset, rounds_np, witness_np, lamport_np, wtable_np,
+     last_round) = _doubling_stage1(grid, putr, st)
+    st["passes"] = st.get("closure_passes", 0) + st.get("walk_chunks", 0) + 1
+
+    la = putr(grid.last_ancestors)
+    fd = putr(grid.first_descendants)
+    index = putr(grid.index)
+    decided, famous, rounds_decided, received = _sharded_fame_received(
+        mesh, grid, wtable_np, la, fd, index, rounds_np,
+        putr(np.int32(last_round)), chunk,
+    )
+
+    rounds = rounds_np
+    received = received.astype(np.int32)
+    if offset:
+        rounds = np.where(rounds >= 0, rounds + offset, rounds)
+        received = np.where(received >= 0, received + offset, received)
+    return PassResults(
+        rounds=rounds.astype(np.int32),
+        witness=witness_np,
+        lamport=lamport_np,
+        witness_table=wtable_np,
+        fame_decided=decided,
+        famous=famous,
+        rounds_decided=rounds_decided,
+        received=received,
+        last_round=last_round + offset,
+        round_offset=offset,
+    )

@@ -142,6 +142,11 @@ def main():
         load_series("MULTICHIP_r*.json", multichip_value),
         "ms/call", min,
     )
+    ok &= check(
+        "catchup cold-ingest throughput",
+        load_series("BENCH_CATCHUP_r*.json", bench_value),
+        "events/s", max,
+    )
     if not ok:
         print(
             f"trend: latest round regressed >"

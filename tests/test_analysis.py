@@ -656,3 +656,34 @@ def test_write_baseline_flag_round_trip(tmp_path, capsys):
     assert lint_main(["--baseline", baseline], root=str(tmp_path)) == 0
     out = capsys.readouterr().out
     assert "1 baselined" in out
+
+
+def test_staging_audit_covers_doubling_cold_path(tmp_path):
+    """The log-diameter cold path (tpu/doubling.py) sits squarely inside
+    the staging-audit + determinism scope: a violation seeded into a
+    scratch copy of the REAL module must fire, and the checked-in module
+    itself must stay clean with the (empty) shipped baseline."""
+    real = Path(REPO_ROOT) / "babble_tpu" / "tpu" / "doubling.py"
+    src = real.read_text()
+    seeded = src + (
+        "\n\n@jax.jit\n"
+        "def _seeded_probe(x):\n"
+        "    if x.sum() > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    p = tmp_path / "babble_tpu" / "tpu" / "doubling.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(seeded)
+    found = _lint(tmp_path).new
+    assert [(f.rule, f.symbol) for f in found] == [
+        ("jax-tracer-branch", "_seeded_probe")
+    ]
+    assert found[0].line > len(src.splitlines())
+
+    clean = run_lint(
+        REPO_ROOT, paths=["babble_tpu/tpu/doubling.py"], baseline_path=None
+    )
+    assert clean.errors == []
+    assert [f.location() for f in clean.new] == []
+    assert clean.files_checked == 1

@@ -116,3 +116,27 @@ def test_m0_binsearch_matches_sort():
             assert int(a.last_round) == int(b.last_round)
     finally:
         frontier.M0_BINSEARCH_MIN_N = orig
+
+
+def test_level_lamport_matches_reference():
+    """The vectorized level-table scatter must equal the per-level loop it
+    replaced — including ragged level rows, whose -1 pad slots carry no
+    scatter — and agree with the exact kernel's lamports on base grids."""
+    from babble_tpu.tpu.grid import synthetic_deep_grid
+
+    grids = [
+        synthetic_grid(4, 64, seed=1),
+        synthetic_grid(16, 1024, seed=4, zipf_a=1.1),
+        synthetic_deep_grid(6, 128, seed=2, zipf_a=1.2),
+    ]
+    for grid in grids:
+        ref = np.zeros(grid.e, dtype=np.int32)
+        for lvl in range(grid.num_levels):
+            for ev in grid.levels[lvl]:
+                if ev >= 0:
+                    ref[ev] = lvl
+        np.testing.assert_array_equal(level_lamport(grid), ref)
+    base = grids[1]
+    np.testing.assert_array_equal(
+        level_lamport(base), np.asarray(run_passes(base).lamport)
+    )
