@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint bench trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint bench bench-mesh trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -30,6 +30,11 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# validator sweep across dispatch disciplines (round-batched mesh rung);
+# archived as BENCH_MESH_r*.json, gated by the trend series below
+bench-mesh:
+	$(PY) bench_mesh_scale.py --slo
 
 # cross-round perf-trend gate over the archived BENCH_r*/MULTICHIP_r*
 # artifacts: fails on a >10% regression against the best prior round

@@ -93,6 +93,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Hold gossip-staged rows up to this many seconds "
                           "(or until a size threshold) before dispatching, "
                           "batching device work across syncs (0 = no hold)")
+    run.add_argument("--dispatch-batch-rows", type=int, default=64,
+                     help="Delta-row threshold that releases a held batch "
+                          "and switches the dispatch onto the round-batched "
+                          "(pointer-doubling) path; also sizes the live "
+                          "engine's device batch")
+    run.add_argument("--mesh-validator-shards", type=int, default=1,
+                     help="With --mesh-devices N: fold the mesh into a 2-D "
+                          "(validators, rounds) layout with this many "
+                          "validator shards (must divide N; 1 = rounds-only)")
     run.add_argument("--metrics", action="store_true",
                      help="Log periodic metrics-registry snapshots at info "
                           "(the registry always serves GET /metrics on the "
@@ -197,6 +206,8 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "mesh-devices": "mesh_devices", "metrics": "metrics",
         "dispatch-queue-depth": "dispatch_queue_depth",
         "dispatch-batch-deadline": "dispatch_batch_deadline",
+        "dispatch-batch-rows": "dispatch_batch_rows",
+        "mesh-validator-shards": "mesh_validator_shards",
     }
     for file_key, attr in mapping.items():
         if file_key in cfg and attr not in explicit:
@@ -209,6 +220,34 @@ def run_command(args: argparse.Namespace) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     logger = logging.getLogger("babble")
+
+    # knob validation: batch sizing is a property of the dispatch queue,
+    # so a non-default --dispatch-batch-rows with queuing disabled is a
+    # configuration contradiction, not something to silently ignore
+    if args.dispatch_batch_rows < 1:
+        logger.error("--dispatch-batch-rows must be >= 1")
+        return 1
+    if args.dispatch_batch_rows != 64 and args.dispatch_queue_depth == 0:
+        logger.error(
+            "--dispatch-batch-rows requires --dispatch-queue-depth > 0 "
+            "(the queued-mesh rung is what batches rows)"
+        )
+        return 1
+    if args.mesh_validator_shards < 1:
+        logger.error("--mesh-validator-shards must be >= 1")
+        return 1
+    if (
+        args.mesh_validator_shards > 1
+        and (
+            args.mesh_devices < 2
+            or args.mesh_devices % args.mesh_validator_shards != 0
+        )
+    ):
+        logger.error(
+            "--mesh-validator-shards=%d must divide --mesh-devices=%d",
+            args.mesh_validator_shards, args.mesh_devices,
+        )
+        return 1
 
     if args.standalone:
         proxy = InmemDummyClient(logger)
@@ -238,6 +277,8 @@ def run_command(args: argparse.Namespace) -> int:
             mesh_devices=args.mesh_devices,
             dispatch_queue_depth=args.dispatch_queue_depth,
             dispatch_batch_deadline=args.dispatch_batch_deadline,
+            dispatch_batch_rows=args.dispatch_batch_rows,
+            mesh_validator_shards=args.mesh_validator_shards,
             metrics_log=args.metrics,
             flightrec_dir=args.flightrec_dir or None,
             slo_enabled=not args.no_slo,

@@ -47,6 +47,19 @@ class Config:
     # injected Clock below — never wallclock — so the deterministic
     # simulator replays the same batching decisions.
     dispatch_batch_deadline: float = 0.0
+    # round-batched dispatch (ISSUE 9): the delta-row count at which a
+    # queued mesh dispatch (a) stops holding for more gossip and (b)
+    # prefers the pointer-doubling cold path so one dispatch carries the
+    # whole multi-round batch. Also sizes the live engine's device batch
+    # (tpu/live.py batch_cap). Only meaningful with dispatch_queue_depth
+    # > 0 — the CLI rejects a non-default value when queuing is disabled.
+    dispatch_batch_rows: int = 64
+    # validator-axis sharding (ISSUE 9): fold mesh_devices into a 2-D
+    # (validators, rounds) mesh with this many validator shards, so fame
+    # voting state (witness/vote/strongly-seen tables) is partitioned
+    # over validators as well as rounds. Must divide mesh_devices; 1 =
+    # the original rounds-only layout.
+    mesh_validator_shards: int = 1
     # time-source seam: every monotonic read and sleep in the node layer
     # goes through this Clock, so the deterministic simulator
     # (babble_tpu/sim/) can drive nodes on virtual time. Production uses

@@ -33,6 +33,8 @@ class Core:
         mesh_devices: int = 0,
         dispatch_queue_depth: int = 4,
         dispatch_batch_deadline: float = 0.0,
+        dispatch_batch_rows: int = 64,
+        mesh_validator_shards: int = 1,
         obs=None,
     ):
         self.id = id_
@@ -63,6 +65,13 @@ class Core:
         # the queued-mesh rung (sync one-shot mesh calls only).
         self.dispatch_queue_depth = dispatch_queue_depth
         self.dispatch_batch_deadline = dispatch_batch_deadline
+        # dispatch_batch_rows: delta-row threshold past which a queued
+        # dispatch prefers the pointer-doubling cold path (round-batched
+        # rung); mesh_validator_shards > 1 folds the device list into a
+        # 2-D (validators, rounds) mesh so voting state is partitioned
+        # over validators as well as rounds
+        self.dispatch_batch_rows = max(1, int(dispatch_batch_rows))
+        self.mesh_validator_shards = max(1, int(mesh_validator_shards))
         self._mesh = None  # built lazily on the first mesh-backend run
         self.device_consensus_runs = 0
         self.device_consensus_fallbacks = 0
@@ -367,6 +376,7 @@ class Core:
                             self.hg, self._get_mesh(),
                             queue_depth=self.dispatch_queue_depth,
                             batch_deadline=self.dispatch_batch_deadline,
+                            batch_rows=self.dispatch_batch_rows,
                         )
                         self.device_consensus_runs += 1
                         self._note_device_up()
@@ -435,6 +445,7 @@ class Core:
                         self.hg,
                         queue_depth=self.dispatch_queue_depth,
                         batch_deadline=self.dispatch_batch_deadline,
+                        batch_cap=self.dispatch_batch_rows,
                     )
                     self.device_consensus_runs += 1
                     self._note_device_up()
@@ -538,10 +549,13 @@ class Core:
         self._device_backoff = 1
 
     def _get_mesh(self):
-        """The node's device mesh (mesh_devices chips on one axis), built
-        once. Raises GridUnsupported when the platform has fewer devices —
-        the caller's ladder then runs the CPU engine instead of crashing
-        the node."""
+        """The node's device mesh, built once. One axis ("shard", over
+        rounds) by default; mesh_validator_shards > 1 folds the same
+        devices into a 2-D ("validators", "rounds") layout so the sharded
+        pipeline partitions voting state over validators too. Raises
+        GridUnsupported when the platform has fewer devices or the shape
+        doesn't divide — the caller's ladder then runs the CPU engine
+        instead of crashing the node."""
         if self._mesh is None:
             import jax
             import numpy as np
@@ -555,9 +569,23 @@ class Core:
                     f"mesh needs {self.mesh_devices} devices, platform has "
                     f"{len(devs)}"
                 )
-            self._mesh = Mesh(
-                np.array(devs[: self.mesh_devices]), ("shard",)
-            )
+            if self.mesh_validator_shards > 1:
+                dv = self.mesh_validator_shards
+                if self.mesh_devices % dv != 0:
+                    raise GridUnsupported(
+                        f"mesh_devices={self.mesh_devices} not divisible by "
+                        f"mesh_validator_shards={dv}"
+                    )
+                self._mesh = Mesh(
+                    np.array(devs[: self.mesh_devices]).reshape(
+                        dv, self.mesh_devices // dv
+                    ),
+                    ("validators", "rounds"),
+                )
+            else:
+                self._mesh = Mesh(
+                    np.array(devs[: self.mesh_devices]), ("shard",)
+                )
         return self._mesh
 
     def _drop_live_engine(self) -> None:

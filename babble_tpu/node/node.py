@@ -112,6 +112,8 @@ class Node(NodeStateMachine):
             mesh_devices=getattr(conf, "mesh_devices", 0),
             dispatch_queue_depth=getattr(conf, "dispatch_queue_depth", 4),
             dispatch_batch_deadline=getattr(conf, "dispatch_batch_deadline", 0.0),
+            dispatch_batch_rows=getattr(conf, "dispatch_batch_rows", 64),
+            mesh_validator_shards=getattr(conf, "mesh_validator_shards", 1),
             obs=self.obs,
         )
         self.core_lock = threading.Lock()

@@ -103,6 +103,8 @@ class SimCluster:
         mesh_devices: int = 0,
         dispatch_queue_depth: int = 4,
         dispatch_batch_deadline: float = 0.0,
+        dispatch_batch_rows: int = 64,
+        mesh_validator_shards: int = 1,
         heartbeat: float = 0.05,
         tcp_timeout: float = 1.0,
         sync_limit: int = 300,
@@ -136,6 +138,8 @@ class SimCluster:
         self.mesh_devices = mesh_devices
         self.dispatch_queue_depth = dispatch_queue_depth
         self.dispatch_batch_deadline = dispatch_batch_deadline
+        self.dispatch_batch_rows = dispatch_batch_rows
+        self.mesh_validator_shards = mesh_validator_shards
         self.heartbeat = heartbeat
         self.tcp_timeout = tcp_timeout
         self.sync_limit = sync_limit
@@ -200,6 +204,8 @@ class SimCluster:
             mesh_devices=self.mesh_devices,
             dispatch_queue_depth=self.dispatch_queue_depth,
             dispatch_batch_deadline=self.dispatch_batch_deadline,
+            dispatch_batch_rows=self.dispatch_batch_rows,
+            mesh_validator_shards=self.mesh_validator_shards,
             clock=self.clock,
             rng=sn.rng,
             logger=self.logger,
@@ -603,6 +609,7 @@ class SimCluster:
             "net": dict(self.net.stats),
             "commit_latency": self.latency_histograms(),
             "stage_latency": self.stage_histograms(),
+            "mesh_dispatch": self.dispatch_histograms(),
             "trace_fingerprint": self.trace_fingerprint(),
             "flightrec_fingerprint": self.flightrec_fingerprint(),
             "flightrec_records": {
@@ -624,6 +631,26 @@ class SimCluster:
                 continue
             snap = sn.node.obs.registry.snapshot()
             out[sn.name] = snap.get("babble_commit_latency_seconds")
+        return out
+
+    DISPATCH_HISTOGRAMS = (
+        "babble_mesh_batch_rows",
+        "babble_mesh_rounds_per_dispatch",
+    )
+
+    def dispatch_histograms(self) -> Dict[str, Any]:
+        """Per-live-node snapshots of the round-batched dispatch
+        histograms (delta rows staged per dispatch, consensus rounds
+        newly covered per integration). Both are DAG facts counted on the
+        deterministic serve path, so same-seed runs must produce
+        byte-identical snapshots — the batching counterpart of
+        commit_latency."""
+        out: Dict[str, Any] = {}
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            snap = sn.node.obs.registry.snapshot()
+            out[sn.name] = {k: snap.get(k) for k in self.DISPATCH_HISTOGRAMS}
         return out
 
     STAGE_HISTOGRAMS = (

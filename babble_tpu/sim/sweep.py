@@ -28,6 +28,8 @@ def run_one(
     mesh_devices: int = 0,
     dispatch_queue_depth: int = 4,
     dispatch_batch_deadline: float = 0.0,
+    dispatch_batch_rows: int = 64,
+    mesh_validator_shards: int = 1,
     until: Optional[float] = 30.0,
     target_block: Optional[int] = None,
     artifact_dir: str = "docs/artifacts",
@@ -53,6 +55,8 @@ def run_one(
         mesh_devices=mesh_devices,
         dispatch_queue_depth=dispatch_queue_depth,
         dispatch_batch_deadline=dispatch_batch_deadline,
+        dispatch_batch_rows=dispatch_batch_rows,
+        mesh_validator_shards=mesh_validator_shards,
         store_dir=store_dir,
         artifact_dir=artifact_dir,
         heartbeat=heartbeat,

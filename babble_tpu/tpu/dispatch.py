@@ -36,11 +36,13 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
-from .grid import GridUnsupported, grid_from_hashgraph
+from .grid import GridStager, GridUnsupported
 
 # size threshold for cross-round dispatch batching: with a deadline set,
 # staged events are held until this many accumulate (or the deadline
-# passes), so the frontier walk amortizes across syncs
+# passes), so the frontier walk amortizes across syncs. This is the
+# DEFAULT for the real knob — Config.dispatch_batch_rows /
+# --dispatch-batch-rows (ISSUE 9 satellite) — not the tunable itself.
 MESH_BATCH_ROWS = 64
 
 # One mesh, one program: collectives rendezvous per device rank, so two
@@ -59,16 +61,16 @@ class _AsyncPass:
     frontier r_cap retry) happen on this thread; the serve thread only
     blocks in result()."""
 
-    def __init__(self, mesh, grid):
+    def __init__(self, mesh, grid, prefer_doubling: bool = False):
         self.done = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
         threading.Thread(
-            target=self._run, args=(mesh, grid), name="mesh-dispatch",
-            daemon=True,
+            target=self._run, args=(mesh, grid, prefer_doubling),
+            name="mesh-dispatch", daemon=True,
         ).start()
 
-    def _run(self, mesh, grid) -> None:
+    def _run(self, mesh, grid, prefer_doubling: bool) -> None:
         try:
             from .doubling import use_doubling
             from .engine import _frontier_safe
@@ -80,7 +82,10 @@ class _AsyncPass:
             )
 
             with _MESH_EXEC_LOCK:
-                if use_doubling(grid):
+                # a batched dispatch (prefer_doubling) lowers the cold-
+                # path crossover: one doubling train amortizes the whole
+                # multi-round batch in O(log depth) passes (ISSUE 9)
+                if use_doubling(grid, prefer=prefer_doubling):
                     # deep section: log-diameter cold path; anything its
                     # kernels cannot certify falls down the resident ladder
                     try:
@@ -115,17 +120,27 @@ class MeshDispatchQueue:
     """
 
     def __init__(self, hg, mesh, queue_depth: int = 4,
-                 batch_deadline: float = 0.0):
+                 batch_deadline: float = 0.0,
+                 batch_rows: int = MESH_BATCH_ROWS):
         self.hg = hg
         self.mesh = mesh
         self.queue_depth = max(1, queue_depth)
         self.batch_deadline = batch_deadline
+        self.batch_rows = max(1, int(batch_rows))
         self.inflight: List[tuple] = []
         self.serves = 0
         self.dispatches = 0
         self.integrations = 0
         self._last_topo = 0  # insertion high-water mark at last dispatch
         self._pending_since: Optional[float] = None
+        # resident staging (ISSUE 9): the grid arrays live across
+        # dispatches; each dispatch appends only the delta rows instead
+        # of re-walking the whole store
+        self.stager = GridStager(hg)
+        # highest round integrated so far — the rounds-per-dispatch
+        # series is the delta of res.last_round across integrations, a
+        # pure DAG fact (deterministic under the sim's byte-equality)
+        self._last_round_seen = -1
         obs = hg.obs
         self._m_stage = obs.histogram(
             "babble_device_stage_seconds",
@@ -150,6 +165,19 @@ class MeshDispatchQueue:
             "Fraction of each dispatch's in-flight time overlapped with "
             "gossip (1.0 = the fetch never blocked the serve path)",
             buckets=[i / 10 for i in range(11)],
+        )
+        from ..obs.metrics import DEFAULT_COUNT_BUCKETS
+
+        self._m_batch_rows = obs.histogram(
+            "babble_mesh_batch_rows",
+            "Delta event rows staged per mesh dispatch (the cross-round "
+            "batch size)",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+        self._m_rounds_per_dispatch = obs.histogram(
+            "babble_mesh_rounds_per_dispatch",
+            "Consensus rounds newly covered per integrated mesh dispatch",
+            buckets=DEFAULT_COUNT_BUCKETS,
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -214,7 +242,7 @@ class MeshDispatchQueue:
         # or Clock-deadline threshold, so one dispatch covers many syncs
         hold = (
             self.batch_deadline > 0.0
-            and 0 < staged_behind < MESH_BATCH_ROWS
+            and 0 < staged_behind < self.batch_rows
             and self._pending_since is not None
             and clock.monotonic() - self._pending_since < self.batch_deadline
         )
@@ -229,13 +257,15 @@ class MeshDispatchQueue:
         hg.process_sig_pool()
 
     def _dispatch(self) -> bool:
-        """Stage the full grid on the serve thread (cheap — the 0.3
-        ms/call side of the r05 breakdown) and hand the sharded pass to
-        a worker. Returns False when the grid is empty."""
+        """Stage the DELTA rows onto the resident grid on the serve
+        thread (the stager keeps the staged arrays across batches, so
+        only rows inserted since the last dispatch are re-walked) and
+        hand the sharded pass to a worker. Returns False when the grid
+        is empty."""
         hg = self.hg
         clock = hg.obs.clock
         t0 = clock.monotonic()
-        grid = grid_from_hashgraph(hg)  # GridUnsupported falls the ladder
+        grid = self.stager.stage()  # GridUnsupported falls the ladder
         topo_hi = hg.topological_index
         dt = clock.monotonic() - t0
         self._m_stage.labels(path="mesh_queued").observe(dt)
@@ -244,21 +274,37 @@ class MeshDispatchQueue:
         self._pending_since = None
         if grid.e == 0:
             return False
+        delta_rows = self.stager.last_delta_rows
+        self._m_batch_rows.observe(float(delta_rows))
+        # a full batch coalesced: route the train down the log-diameter
+        # cold path (one doubling train per batch instead of a frontier
+        # walk per round — the ISSUE 9 round-batched discipline)
+        batched = delta_rows >= self.batch_rows
         hg.obs.gauge(
             "babble_mesh_staged_events",
             "Events staged onto the mesh in the latest mesh call",
         ).set(grid.e)
+        from .sharded import mesh_validator_shards
+
+        hg.obs.gauge(
+            "babble_mesh_validator_shards",
+            "Validator-axis extent of the consensus mesh (1 = voting "
+            "state unsharded over validators)",
+        ).set(float(mesh_validator_shards(self.mesh)))
         hg.obs.tracer.record(
             "device.dispatch", t0, dt,
-            {"node": hg.obs.node_id, "batches": 1},
+            {"node": hg.obs.node_id, "batches": 1, "rows": delta_rows},
         )
         self.inflight.append(
-            (_AsyncPass(self.mesh, grid), grid, topo_hi, clock.monotonic())
+            (
+                _AsyncPass(self.mesh, grid, prefer_doubling=batched),
+                grid, topo_hi, clock.monotonic(),
+            )
         )
         self.dispatches += 1
         hg.obs.flightrec.record(
             "dispatch.enqueue", events=grid.e, topo_hi=topo_hi,
-            depth=len(self.inflight),
+            depth=len(self.inflight), rows=delta_rows,
         )
         return True
 
@@ -285,14 +331,21 @@ class MeshDispatchQueue:
         )
         integrate_pass_results(hg, grid, res, topo_hi=topo_hi)
         self.integrations += 1
+        # rounds newly covered by this dispatch: a DAG fact (last_round
+        # delta), so the histogram is byte-identical across same-seed
+        # sim runs regardless of worker timing
+        new_rounds = max(0, int(res.last_round) - self._last_round_seen)
+        self._last_round_seen = max(self._last_round_seen, int(res.last_round))
+        self._m_rounds_per_dispatch.observe(float(new_rounds))
         hg.obs.flightrec.record(
             "dispatch.integrate", blocked=dt, depth=len(self.inflight),
-            integrations=self.integrations,
+            integrations=self.integrations, rounds=new_rounds,
         )
 
 
 def run_consensus_mesh_queued(hg, mesh, queue_depth: int = 4,
-                              batch_deadline: float = 0.0) -> None:
+                              batch_deadline: float = 0.0,
+                              batch_rows: int = MESH_BATCH_ROWS) -> None:
     """Queued-mesh rung entry point: get-or-create the hashgraph's
     dispatch queue and serve one consensus call through it. The queue
     hangs off the hashgraph like the live engine does, so Core's
@@ -301,6 +354,7 @@ def run_consensus_mesh_queued(hg, mesh, queue_depth: int = 4,
     if q is None:
         q = MeshDispatchQueue(
             hg, mesh, queue_depth=queue_depth, batch_deadline=batch_deadline,
+            batch_rows=batch_rows,
         )
         hg._mesh_dispatch_queue = q
     q.serve()

@@ -88,6 +88,12 @@ from .kernels import _decide_fame, _decide_round_received
 # with a number (both paths) or "auto" (one-shot timing probe).
 _CROSSOVER_BASE = 1024
 _CROSSOVER_SEEDED = 192
+# round-batched mesh dispatch (tpu/dispatch.py, ISSUE 9): a dispatch
+# that coalesced a full batch of rows amortizes its fixed overhead over
+# many rounds, so the O(log depth) cold path wins much earlier than the
+# per-sync crossover — one doubling train replaces a frontier walk whose
+# step count grows with the whole DAG's depth
+_CROSSOVER_BATCHED = 64
 
 _calibrated: Optional[tuple] = None
 
@@ -135,11 +141,17 @@ def doubling_crossover(seeded: bool) -> int:
     return _CROSSOVER_SEEDED if seeded else _CROSSOVER_BASE
 
 
-def use_doubling(grid: DagGrid) -> bool:
-    """Ladder predicate: deep enough that log-diameter passes win."""
+def use_doubling(grid: DagGrid, prefer: bool = False) -> bool:
+    """Ladder predicate: deep enough that log-diameter passes win.
+    `prefer` (the queued-mesh batched-train path) lowers the crossover —
+    a multi-round batch pays one dispatch for the whole train, so the
+    log-depth pass count beats the per-level/per-round scans sooner."""
     if grid.e == 0:
         return False
-    return grid.num_levels >= doubling_crossover(not _frontier_safe(grid))
+    cross = doubling_crossover(not _frontier_safe(grid))
+    if prefer:
+        cross = min(cross, _CROSSOVER_BATCHED)
+    return grid.num_levels >= cross
 
 
 # ---------------------------------------------------------------------------

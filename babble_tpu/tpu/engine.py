@@ -471,9 +471,13 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         labels=("path",),
     )
     if mesh is not None:
-        from .doubling import observe_catchup, sharded_doubling_passes, use_doubling
+        from .doubling import observe_catchup, use_doubling
         from .dispatch import _MESH_EXEC_LOCK
-        from .sharded import sharded_frontier_passes, sharded_run_passes
+        from .sharded import (
+            sharded_doubling_passes,
+            sharded_frontier_passes,
+            sharded_run_passes,
+        )
 
         # serialize against queued-mesh workers: an orphaned dispatch
         # (demotion discards the queue, not the running worker) would
@@ -503,6 +507,11 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
             "babble_mesh_staged_events",
             "Events staged onto the mesh in the latest mesh call",
         ).set(grid.e)
+        from .sharded import mesh_validator_shards
+        obs.gauge(
+            "babble_mesh_validator_shards",
+            "Validator-axis shards in the active mesh layout",
+        ).set(mesh_validator_shards(mesh))
     else:
         from .doubling import observe_catchup, run_doubling_passes, use_doubling
 

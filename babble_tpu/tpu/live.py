@@ -767,7 +767,8 @@ def _unpack_results(packed, e_win: int, r_cap: int, n: int):
 
 
 def run_consensus_live(hg, queue_depth: int = None,
-                       batch_deadline: float = None) -> None:
+                       batch_deadline: float = None,
+                       batch_cap: int = None) -> None:
     """Incremental device consensus for a live node: advance the persistent
     state by the events inserted since the last call, then write decisions
     back and run the host passes (mirrors engine.run_consensus_device's
@@ -800,7 +801,8 @@ def run_consensus_live(hg, queue_depth: int = None,
     eng: Optional[LiveDeviceEngine] = getattr(hg, "_live_device_engine", None)
     if eng is None:
         eng = LiveDeviceEngine(
-            hg, queue_depth=queue_depth, batch_deadline=batch_deadline
+            hg, queue_depth=queue_depth, batch_deadline=batch_deadline,
+            batch_cap=batch_cap,
         )
         hg._live_device_engine = eng
         # the bootstrap replayed the whole pre-existing DAG on device; its

@@ -57,6 +57,30 @@ except AttributeError:
 _frontier_post_jit = jax.jit(frontier_post)
 
 
+def _mesh_axes(mesh: Mesh):
+    """(rounds_axis, validator_axis) of a consensus mesh. 1-D meshes
+    shard rounds/events/chains over their single axis (validator_axis
+    None); 2-D ``(validators, rounds)`` meshes — node/core.py
+    ``mesh_validator_shards`` — additionally partition the fame working
+    set's witness axis, so the per-device voting state shrinks by the
+    validator-shard count (ISSUE 9: the MPC-style per-machine graph
+    shard)."""
+    names = mesh.axis_names
+    if len(names) == 1:
+        return names[0], None
+    if len(names) == 2:
+        return names[1], names[0]
+    from .grid import GridUnsupported
+
+    raise GridUnsupported(f"unsupported mesh rank: axes {names!r}")
+
+
+def mesh_validator_shards(mesh: Mesh) -> int:
+    """Validator-axis extent of the mesh (1 on 1-D meshes)."""
+    _, v_axis = _mesh_axes(mesh)
+    return int(mesh.shape[v_axis]) if v_axis is not None else 1
+
+
 def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
     out = np.full((size,) + a.shape[1:], fill, dtype=a.dtype)
     out[: a.shape[0]] = a
@@ -65,7 +89,7 @@ def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
 
 @functools.lru_cache(maxsize=16)
 def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
-                  super_majority: int, d_bound: int):
+                  super_majority: int, d_bound: int, v_axis=None):
     """Build the shard_mapped fame voting pass for a mesh: the WHOLE
     voting loop runs in one dispatch, early-exiting ON DEVICE via a
     lax.while_loop whose continue-flag is a psum across the mesh
@@ -73,11 +97,21 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
     serialized every voting chunk on host RTT; this matches the
     single-device discipline of kernels.consensus_pipeline). `d_bound`
     is the static safety cap on the voting offset (r_pad + 2), bucketed
-    by the caller so the cache stays small."""
-    ndev = int(np.prod(mesh.devices.shape))
+    by the caller so the cache stays small.
+
+    With `v_axis` (a 2-D (validators, rounds) mesh) the voted-witness
+    axis is additionally partitioned: each device holds only its
+    witness-column slice of the strongly-see tensor and vote matrix, the
+    per-step tally is a LOCAL einsum over that slice closed by one psum
+    of the (B, N_y, N_x) yay/total counts over the validator axis, and
+    each shard slices its own witness rows back out of the replicated
+    next-vote tensor — per-shard local voting plus one all-reduce per
+    step, the MPC per-machine-shard discipline (ISSUE 9)."""
+    ndev_r = int(mesh.shape[axis])
     # send my first row to the previous device: a left ring-shift of the
-    # globally R-sharded j-aligned tensors
-    perm = [(i, (i - 1) % ndev) for i in range(ndev)]
+    # globally R-sharded j-aligned tensors (along the rounds axis only —
+    # every validator shard ring-shifts its own witness slice)
+    perm = [(i, (i - 1) % ndev_r) for i in range(ndev_r)]
 
     def local_fame(last_round, i_rows, wvalid, votes, decided, famous,
                    ss_s, wv_s, coin_s):
@@ -101,6 +135,11 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
                 preferred_element_type=jnp.float32,
             ).astype(jnp.int32)
             total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
+            if v_axis is not None:
+                # close the witness-shard partial tallies: one psum per
+                # voting step over the validator axis
+                yays = jax.lax.psum(yays, v_axis)
+                total = jax.lax.psum(total, v_axis)
             nays = total[:, :, None] - yays
             v = yays >= nays
             t = jnp.where(v, yays, nays)
@@ -121,7 +160,16 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
             decided = decided | any_decide
 
             coin_votes = jnp.where(strong, v, coin_s[:, :, None])
-            votes = jnp.where(is_coin, coin_votes, v)
+            new_votes = jnp.where(is_coin, coin_votes, v)
+            if v_axis is not None:
+                # voters y of this step are the voted witnesses w of the
+                # next: each shard keeps only its witness-row slice
+                w_local = votes.shape[1]
+                off = jax.lax.axis_index(v_axis) * w_local
+                new_votes = jax.lax.dynamic_slice_in_dim(
+                    new_votes, off, w_local, axis=1
+                )
+            votes = new_votes
             return (votes, decided, famous, shift1(ss_s), shift1(wv_s),
                     shift1(coin_s), d0), None
 
@@ -156,8 +204,12 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
         return votes, decided, famous
 
     shp2 = P(axis, None)
-    shp3 = P(axis, None, None)
     rep = P()
+    # votes carry the witness axis in dim 1, the strongly-see tensor in
+    # dim 2; on 1-D meshes v_axis is None and the P entries collapse to
+    # the fully-replicated trailing dims of the original layout
+    votes_spec = P(axis, v_axis, None)
+    ss_spec = P(axis, None, v_axis)
     # buffer donation (ISSUE 6): votes/decided/famous/ss_s/wv_s/coin_s
     # (positions 3-8) are freshly device_put per call by
     # _sharded_fame_received and never read after the dispatch, so XLA
@@ -169,17 +221,23 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
         _shard_map(
             local_fame,
             mesh=mesh,
-            in_specs=(rep, P(axis), shp2, shp3, shp2, shp2, shp3, shp2, shp2),
-            out_specs=(shp3, shp2, shp2),
+            in_specs=(rep, P(axis), shp2, votes_spec, shp2, shp2,
+                      ss_spec, shp2, shp2),
+            out_specs=(votes_spec, shp2, shp2),
         ),
         donate_argnums=(3, 4, 5, 6, 7, 8),
     )
 
 
 @functools.lru_cache(maxsize=8)
-def _received_fn(mesh: Mesh, axis: str):
+def _received_fn(mesh: Mesh, axis):
     """shard_mapped DecideRoundReceived: events sharded, fame tables
-    replicated; pure local map (no collectives needed)."""
+    replicated; pure local map (no collectives needed). `axis` may be a
+    tuple of mesh axes — a 2-D mesh shards the event axis over every
+    device. Every input is freshly staged (padded event columns,
+    just-computed fame tables) and never read after this dispatch, so
+    all seven are donated (ISSUE 9: the received stage stops
+    double-buffering, same as the fame loop's carried set)."""
 
     def local_received(index, creator, rounds, min_la, famous_count, i_ok,
                        horizon):
@@ -197,7 +255,8 @@ def _received_fn(mesh: Mesh, axis: str):
             mesh=mesh,
             in_specs=(shp, shp, shp, rep, rep, rep, rep),
             out_specs=shp,
-        )
+        ),
+        donate_argnums=(0, 1, 2, 3, 4, 5, 6),
     )
 
 
@@ -219,28 +278,52 @@ def _sharded_fame_received(
 ):
     """Passes 2+3 over the mesh, shared by the level-scan and frontier
     entry points: rounds-sharded fame voting with ring-shifted voters,
-    then events-sharded round-received. Returns host numpy results."""
-    axis = mesh.axis_names[0]
-    ndev = int(np.prod(mesh.devices.shape))
+    then events-sharded round-received. On a 2-D (validators, rounds)
+    mesh the voting working set (strongly-see tensor, vote matrix) is
+    additionally partitioned over the witness axis, so per-device fame
+    state is (R/dr, N, N/dv) instead of (R/dr, N, N) — the validator
+    memory ceiling scales out with the mesh (ISSUE 9 tentpole leg 2).
+    Returns host numpy results."""
+    axis, v_axis = _mesh_axes(mesh)
+    ndev_r = int(mesh.shape[axis])
+    ndev_v = int(mesh.shape[v_axis]) if v_axis is not None else 1
+    ndev = ndev_r * ndev_v
+    ev_axes = (v_axis, axis) if v_axis is not None else axis
     rep = NamedSharding(mesh, P())
     shard_r = NamedSharding(mesh, P(axis))
     shard_r2 = NamedSharding(mesh, P(axis, None))
-    shard_r3 = NamedSharding(mesh, P(axis, None, None))
+    # witness-axis partitioning (None entries collapse on 1-D meshes):
+    shard_ss = NamedSharding(mesh, P(axis, None, v_axis))
+    shard_votes = NamedSharding(mesh, P(axis, v_axis, None))
+    shard_coin = NamedSharding(mesh, P(axis, None))
 
     r_rows = wtable_np.shape[0]
-    r_pad = ((r_rows + ndev - 1) // ndev) * ndev
+    r_pad = ((r_rows + ndev_r - 1) // ndev_r) * ndev_r
     e_pad = ((max(grid.e, 1) + ndev - 1) // ndev) * ndev
+    n_pad_v = ((grid.n + ndev_v - 1) // ndev_v) * ndev_v
 
     putr = lambda x: jax.device_put(np.asarray(x), rep)
     wtable = putr(_pad_axis0(wtable_np, r_pad, -1))
     ss, votes0, wvalid, coin_w = kernels._fame_setup(
         wtable, la, fd, index, putr(grid.coin_bit), grid.super_majority
     )
+    # witness-axis padding for the validator shards: padded columns are
+    # never strongly seen (ss False) so their garbage vote rows tally 0,
+    # and padded voter rows are invalid (wv False) so they decide nothing
+    padw = n_pad_v - grid.n
+    ss_y = ss
+    wv_y = wvalid
+    coin_y = coin_w
+    if padw:
+        ss_y = jnp.pad(ss, ((0, 0), (0, padw), (0, padw)))
+        votes0 = jnp.pad(votes0, ((0, 0), (0, padw), (0, 0)))
+        wv_y = jnp.pad(wvalid, ((0, 0), (0, padw)))
+        coin_y = jnp.pad(coin_w, ((0, 0), (0, padw)))
     # j-aligned buffers start at d0=2: a global left-shift by 2
-    ss_s = jax.device_put(jnp.roll(ss, -2, axis=0), shard_r3)
-    wv_s = jax.device_put(jnp.roll(wvalid, -2, axis=0), shard_r2)
-    coin_s = jax.device_put(jnp.roll(coin_w, -2, axis=0), shard_r2)
-    votes = jax.device_put(votes0, shard_r3)
+    ss_s = jax.device_put(jnp.roll(ss_y, -2, axis=0), shard_ss)
+    wv_s = jax.device_put(jnp.roll(wv_y, -2, axis=0), shard_r2)
+    coin_s = jax.device_put(jnp.roll(coin_y, -2, axis=0), shard_coin)
+    votes = jax.device_put(votes0, shard_votes)
     wvalid_s = jax.device_put(wvalid, shard_r2)
     decided = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
     famous = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
@@ -250,7 +333,7 @@ def _sharded_fame_received(
     # (d_bound bucketed to the padded round count so the compiled
     # executable is reused across similarly-sized batches)
     fame_loop = _fame_loop_fn(
-        mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2
+        mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2, v_axis
     )
     votes, decided, famous = fame_loop(
         last_round, i_rows, wvalid_s, votes, decided, famous,
@@ -261,9 +344,9 @@ def _sharded_fame_received(
         wtable, la, decided, famous, last_round
     )
     pute = lambda x, fill: jax.device_put(
-        _pad_axis0(np.asarray(x), e_pad, fill), NamedSharding(mesh, P(axis))
+        _pad_axis0(np.asarray(x), e_pad, fill), NamedSharding(mesh, P(ev_axes))
     )
-    received = _received_fn(mesh, axis)(
+    received = _received_fn(mesh, ev_axes)(
         pute(grid.index, 0), pute(grid.creator, 0),
         pute(rounds_np, -1),
         jax.device_put(min_la, rep), jax.device_put(famous_count, rep),
@@ -337,9 +420,10 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
 
 
 @functools.lru_cache(maxsize=8)
-def _sharded_build_inv_fn(mesh: Mesh, axis: str):
+def _sharded_build_inv_fn(mesh: Mesh, axis):
     """shard_mapped build_inv: each device builds the INV slices of its
-    own chains (pure local compute, no collectives)."""
+    own chains (pure local compute, no collectives). `axis` may be a
+    tuple of mesh axes (2-D mesh: chains sharded over every device)."""
     from .frontier import build_inv
 
     return jax.jit(
@@ -353,10 +437,12 @@ def _sharded_build_inv_fn(mesh: Mesh, axis: str):
 
 
 @functools.lru_cache(maxsize=8)
-def _frontier_walk_fn(mesh: Mesh, axis: str, super_majority: int, r_cap: int,
+def _frontier_walk_fn(mesh: Mesh, axis, super_majority: int, r_cap: int,
                       l: int):
     """shard_mapped frontier walk: INV and the chain table sharded over
-    chains; fd/la replicated; the whole r_cap-step scan runs in ONE
+    chains (`axis` is a tuple of mesh axes on a 2-D mesh — the
+    all-gathers then ride the full device set); fd/la replicated; the
+    whole r_cap-step scan runs in ONE
     dispatch with two (N/ndev,)-sized all-gathers per step riding ICI.
     The m0 stage mirrors the single-device form switch (frontier.py):
     einsum+sort for small N, per-chain binary search for large N (the
@@ -453,7 +539,8 @@ def sharded_frontier_passes(
     from .engine import pad_grid, _bucket
     from .frontier import chain_table, level_lamport, sp_index_of
 
-    axis = mesh.axis_names[0]
+    r_axis, v_axis = _mesh_axes(mesh)
+    axis = (v_axis, r_axis) if v_axis is not None else r_axis
     ndev = int(np.prod(mesh.devices.shape))
     rep = NamedSharding(mesh, P())
 

@@ -1,0 +1,407 @@
+"""Mesh-scale benchmark: validator sweep across dispatch disciplines for
+the round-batched sharded backend (babble_tpu/tpu/dispatch.py +
+sharded.py; ROADMAP item 1, ISSUE 9).
+
+For each validator count in the sweep the workload is a stream of CALLS
+gossip syncs delivering one synthetic DAG, and three disciplines move it
+through ordering:
+
+- sync          — every sync blocks on a full sharded pipeline (the r05
+                  one-shot rung);
+- queued        — bounded multi-slot dispatch queue, one dispatch per
+                  sync (the r06 queued rung: 51.3 ms/call device-blocked);
+- round_batched — the ISSUE 9 rung: BATCH_SYNCS syncs accumulate into
+                  ONE dispatch that rides the pointer-doubling cold path
+                  (use_doubling prefer=True), so the fixed dispatch
+                  overhead amortizes across every round in the batch.
+
+Every discipline's pass results are byte-equality-gated against the CPU
+oracle (run_frontier_passes) before any number is reported — the
+discipline may only change WHEN the device runs, never what comes out.
+
+Rounds-per-dispatch accounting: the gossip stream delivers the grid's
+rounds over CALLS syncs, so a discipline that dispatches once per k
+syncs covers k/CALLS of the grid's rounds per dispatch — the bench-side
+mirror of the babble_mesh_rounds_per_dispatch histogram the live queue
+observes at integration time. A sweep point's rounds/dispatch is bounded
+by the rounds its workload contains, and interactive-scale grids are
+shallow (4 generations per validator ≈ a single round), so the sweep
+numbers stay in the JSON as bookkeeping while the histogram — and the
+--slo floor — are fed by a dedicated deep CATCH-UP ANCHOR
+(ANCHOR_N validators, --anchor-events events ≈ 128 generations ≈ 12
+rounds): the stream a node replays when it is many rounds behind, which
+is exactly the regime round batching exists for.
+
+Prints the headline as the LAST line (driver-parsable):
+  {"metric": ..., "value": <batched events/s at the largest sweep
+   point>, "unit": "events/s", "vs_baseline": <batched/sync>,
+   "rounds_per_dispatch": ..., "validator_shards": ...,
+   "validators": {...}, "metrics": {...}}
+
+`--slo` gates the run on the rounds-per-dispatch floor: the batched
+discipline must sustain a mean of at least --slo-min-rounds (default 4)
+rounds per dispatch, declared as a mean_above SLO objective (obs/slo.py)
+and evaluated once; breach exits nonzero with the report on stderr.
+
+The default sweep (8,64,128) plus the anchor runs in a few minutes on
+the CPU mesh — the 8-validator rung is directly comparable to
+dryrun_multichip's r06 51.3 ms/call queued figure; pass
+--validators 64,256,1024,4096 on real hardware for the full ISSUE 9
+range.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SEED = 13
+CALLS = 16          # gossip syncs per discipline
+QUEUE_DEPTH = 4     # queued: max dispatches in flight
+BATCH_SYNCS = 8     # round_batched: syncs accumulated per dispatch
+ANCHOR_N = 64       # catch-up anchor: validators (smallest sweep rung)
+# finite gossip arrival cadence — overlap and batching only show up
+# against an arrival model (see bench_dispatch.py)
+GOSSIP_INTERVAL_S = 0.005
+
+
+def slo_gate(obs, min_rounds: float):
+    """Declare the rounds-per-dispatch floor and evaluate once. Returns
+    (ok, status_doc)."""
+    from babble_tpu.obs import SLOEngine
+
+    slo = SLOEngine(obs)
+    slo.objective(
+        "mesh_rounds_per_dispatch",
+        series="babble_mesh_rounds_per_dispatch",
+        kind="mean_above", threshold=min_rounds,
+        description="round-batched dispatches keep covering at least "
+                    "this many consensus rounds each",
+    )
+    status = slo.evaluate()
+    return not slo.breached(), status
+
+
+def build_mesh(devices, validator_shards):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n_dev = 1
+    while n_dev * 2 <= min(8, len(devices)):
+        n_dev *= 2
+    dv = validator_shards
+    if dv > 1 and (n_dev < 2 * dv or n_dev % dv):
+        dv = 1
+    if dv > 1:
+        mesh = Mesh(
+            np.array(devices[:n_dev]).reshape(dv, n_dev // dv),
+            ("validators", "rounds"),
+        )
+    else:
+        mesh = Mesh(np.array(devices[:n_dev]), ("rounds",))
+    return mesh, n_dev, dv
+
+
+def run_sweep_point(mesh, n, events, oracle_cache):
+    """One validator count: build the grid, gate every discipline against
+    the CPU oracle, return the per-discipline numbers."""
+    import numpy as np
+
+    from babble_tpu.tpu.dispatch import _AsyncPass
+    from babble_tpu.tpu.engine import run_frontier_passes
+    from babble_tpu.tpu.grid import build_levels, synthetic_grid
+    from babble_tpu.tpu.sharded import sharded_frontier_passes
+
+    grid = synthetic_grid(n, events, seed=SEED)
+    ref = run_frontier_passes(grid)  # CPU oracle
+    oracle_cache[n] = ref
+
+    def gossip_stage():
+        time.sleep(GOSSIP_INTERVAL_S)
+        return build_levels(n, grid.self_parent, grid.other_parent)
+
+    def gate(out):
+        np.testing.assert_array_equal(
+            np.asarray(out.rounds), np.asarray(ref.rounds)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.received), np.asarray(ref.received)
+        )
+        assert int(out.last_round) == int(ref.last_round)
+
+    # compile + warm both device paths outside the timed loops
+    gate(sharded_frontier_passes(mesh, grid))
+    gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())
+
+    wall, blocked, dispatches = {}, {}, {}
+
+    # -- sync -------------------------------------------------------------
+    t0 = time.perf_counter()
+    b = 0.0
+    for _ in range(CALLS):
+        gossip_stage()
+        tb = time.perf_counter()
+        out = sharded_frontier_passes(mesh, grid)
+        b += time.perf_counter() - tb
+    wall["sync"] = time.perf_counter() - t0
+    blocked["sync"], dispatches["sync"] = b, CALLS
+
+    # -- queued: bounded queue, one dispatch per sync ---------------------
+    t0 = time.perf_counter()
+    b = 0.0
+    inflight = []
+    for _ in range(CALLS):
+        gossip_stage()
+        while len(inflight) >= QUEUE_DEPTH:
+            tb = time.perf_counter()
+            out = inflight.pop(0).result()
+            b += time.perf_counter() - tb
+        inflight.append(_AsyncPass(mesh, grid))
+    while inflight:
+        tb = time.perf_counter()
+        out = inflight.pop(0).result()
+        b += time.perf_counter() - tb
+    gate(out)
+    wall["queued"] = time.perf_counter() - t0
+    blocked["queued"], dispatches["queued"] = b, CALLS
+
+    # -- round_batched: BATCH_SYNCS syncs -> one doubling dispatch --------
+    t0 = time.perf_counter()
+    b = 0.0
+    inflight = []
+    pending = 0
+    n_disp = 0
+    for _ in range(CALLS):
+        gossip_stage()
+        pending += 1
+        if pending < BATCH_SYNCS:
+            continue
+        while len(inflight) >= QUEUE_DEPTH:
+            tb = time.perf_counter()
+            out = inflight.pop(0).result()
+            b += time.perf_counter() - tb
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        n_disp += 1
+        pending = 0
+    if pending:
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        n_disp += 1
+    while inflight:
+        tb = time.perf_counter()
+        out = inflight.pop(0).result()
+        b += time.perf_counter() - tb
+    gate(out)
+    wall["round_batched"] = time.perf_counter() - t0
+    blocked["round_batched"], dispatches["round_batched"] = b, n_disp
+
+    total_rounds = int(ref.last_round) + 1
+    return {
+        name: {
+            "events_per_sec": round(events / wall[name], 1),
+            "ms_per_call": round(blocked[name] / CALLS * 1e3, 2),
+            "dispatches": dispatches[name],
+            "rounds_per_dispatch": round(total_rounds / dispatches[name], 2),
+            "wall_s": round(wall[name], 3),
+        }
+        for name in ("sync", "queued", "round_batched")
+    }
+
+
+def run_catchup_anchor(mesh, events, rpd_hist):
+    """Deep catch-up stream: one grid of ~events/ANCHOR_N generations
+    replayed through the round-batched discipline only. Every dispatch's
+    round coverage is observed into rpd_hist — this is the series the
+    --slo floor gates on."""
+    import numpy as np
+
+    from babble_tpu.tpu.dispatch import _AsyncPass
+    from babble_tpu.tpu.engine import run_frontier_passes
+    from babble_tpu.tpu.grid import synthetic_grid
+
+    grid = synthetic_grid(ANCHOR_N, events, seed=SEED)
+    ref = run_frontier_passes(grid)
+    total_rounds = int(ref.last_round) + 1
+
+    def gate(out):
+        np.testing.assert_array_equal(
+            np.asarray(out.rounds), np.asarray(ref.rounds)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.received), np.asarray(ref.received)
+        )
+        assert int(out.last_round) == int(ref.last_round)
+
+    gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())  # compile
+
+    t0 = time.perf_counter()
+    b = 0.0
+    inflight = []
+    pending = 0
+    n_disp = 0
+    for _ in range(CALLS):
+        time.sleep(GOSSIP_INTERVAL_S)
+        pending += 1
+        if pending < BATCH_SYNCS:
+            continue
+        while len(inflight) >= QUEUE_DEPTH:
+            tb = time.perf_counter()
+            out = inflight.pop(0).result()
+            b += time.perf_counter() - tb
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        n_disp += 1
+        pending = 0
+    if pending:
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        n_disp += 1
+    while inflight:
+        tb = time.perf_counter()
+        out = inflight.pop(0).result()
+        b += time.perf_counter() - tb
+    gate(out)
+    wall = time.perf_counter() - t0
+
+    # each dispatch carries BATCH_SYNCS/CALLS of the stream's rounds
+    per_dispatch = round(total_rounds * BATCH_SYNCS / CALLS, 2)
+    for _ in range(n_disp):
+        rpd_hist.observe(per_dispatch)
+    return {
+        "validators": ANCHOR_N,
+        "events": events,
+        "rounds": total_rounds,
+        "events_per_sec": round(events / wall, 1),
+        "ms_per_call": round(b / CALLS * 1e3, 2),
+        "dispatches": n_disp,
+        "rounds_per_dispatch": per_dispatch,
+        "wall_s": round(wall, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--validators", default="8,64,128",
+                    help="Comma-separated validator sweep (8 is the "
+                         "r06-comparable rung — dryrun_multichip's 51.3 "
+                         "ms/call queued figure was measured at 8 "
+                         "validators; full ISSUE 9 range: "
+                         "64,256,1024,4096 — the CPU virtual mesh "
+                         "serializes collectives onto shared cores, so "
+                         "256+ belongs on real hardware)")
+    ap.add_argument("--events", type=int, default=0,
+                    help="Events per sweep point (0 = 4x validators, "
+                         "capped at 2048)")
+    ap.add_argument("--anchor-events", type=int, default=8192,
+                    help="Events in the deep catch-up anchor grid that "
+                         "feeds babble_mesh_rounds_per_dispatch and the "
+                         "--slo floor (0 skips the anchor)")
+    ap.add_argument("--validator-shards", type=int, default=2,
+                    help="Validator-axis shards for the 2-D mesh (falls "
+                         "back to 1-D when the platform is too small)")
+    ap.add_argument("--slo", action="store_true",
+                    help="Gate the run on the rounds-per-dispatch floor: "
+                         "exit 1 when the batched discipline's mean drops "
+                         "under --slo-min-rounds")
+    ap.add_argument("--slo-min-rounds", type=float, default=4.0,
+                    help="Floor on mean consensus rounds covered per "
+                         "batched dispatch for --slo")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    sweep = [int(x) for x in args.validators.split(",") if x.strip()]
+    devices = jax.devices()
+    mesh, n_dev, dv = build_mesh(devices, args.validator_shards)
+
+    from babble_tpu.obs import Observability, log_buckets
+    from babble_tpu.obs.metrics import DEFAULT_COUNT_BUCKETS
+
+    obs = Observability()
+    lat = obs.histogram(
+        "babble_bench_mesh_blocked_seconds",
+        "Blocked device wall time per gossip sync, by discipline and "
+        "validator count",
+        labels=("path", "validators"),
+        buckets=log_buckets(0.0001, 4.0, 20),
+    )
+    thr = obs.gauge(
+        "babble_bench_mesh_events_per_second",
+        "Mesh-scale benchmark throughput, by discipline and validator "
+        "count",
+        labels=("path", "validators"),
+    )
+    rpd = obs.histogram(
+        "babble_mesh_rounds_per_dispatch",
+        "Consensus rounds newly covered per integrated mesh dispatch",
+        buckets=DEFAULT_COUNT_BUCKETS,
+    )
+    obs.gauge(
+        "babble_mesh_validator_shards",
+        "Validator-axis shards in the active mesh layout",
+    ).set(dv)
+
+    oracle_cache = {}
+    per_n = {}
+    for n in sweep:
+        events = args.events or min(4 * n, 2048)
+        per_n[str(n)] = run_sweep_point(mesh, n, events, oracle_cache)
+        for name, d in per_n[str(n)].items():
+            lat.labels(path=name, validators=str(n)).observe(
+                d["ms_per_call"] / 1e3
+            )
+            thr.labels(path=name, validators=str(n)).set(d["events_per_sec"])
+
+    anchor = None
+    if args.anchor_events:
+        anchor = run_catchup_anchor(mesh, args.anchor_events, rpd)
+
+    top = per_n[str(sweep[-1])]
+    headline_rpd = (
+        anchor["rounds_per_dispatch"] if anchor
+        else top["round_batched"]["rounds_per_dispatch"]
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "events ordered/sec through the round-batched sharded "
+                    f"mesh, validator sweep {sweep[0]}..{sweep[-1]}, "
+                    f"mesh={n_dev}dev x{dv} validator shards, "
+                    f"platform={devices[0].platform}"
+                ),
+                "value": top["round_batched"]["events_per_sec"],
+                "unit": "events/s",
+                "vs_baseline": round(
+                    top["round_batched"]["events_per_sec"]
+                    / max(top["sync"]["events_per_sec"], 1e-9), 2
+                ),
+                "rounds_per_dispatch": headline_rpd,
+                "validator_shards": dv,
+                "catchup_anchor": anchor,
+                "validators": per_n,
+                "metrics": obs.registry.snapshot(),
+            }
+        )
+    )
+
+    if args.slo:
+        ok, status = slo_gate(obs, args.slo_min_rounds)
+        print(
+            "SLO gate:",
+            json.dumps(status["objectives"], sort_keys=True),
+            file=sys.stderr,
+        )
+        if not ok:
+            print(
+                f"SLO BREACH: round-batched dispatches covered "
+                f"{headline_rpd} rounds/dispatch, under the "
+                f"{args.slo_min_rounds} floor",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

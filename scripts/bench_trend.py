@@ -131,25 +131,31 @@ def check(name, series, unit, better):
 
 
 def main():
-    ok = True
-    ok &= check(
-        "bench throughput",
-        load_series("BENCH_r*.json", bench_value),
-        "events/s", max,
+    gates = (
+        ("bench throughput", "BENCH_r*.json", bench_value, "events/s", max),
+        (
+            "multichip blocked device time", "MULTICHIP_r*.json",
+            multichip_value, "ms/call", min,
+        ),
+        (
+            "catchup cold-ingest throughput", "BENCH_CATCHUP_r*.json",
+            bench_value, "events/s", max,
+        ),
+        (
+            "mesh scale throughput", "BENCH_MESH_r*.json", bench_value,
+            "events/s", max,
+        ),
     )
-    ok &= check(
-        "multichip blocked device time",
-        load_series("MULTICHIP_r*.json", multichip_value),
-        "ms/call", min,
-    )
-    ok &= check(
-        "catchup cold-ingest throughput",
-        load_series("BENCH_CATCHUP_r*.json", bench_value),
-        "events/s", max,
-    )
-    if not ok:
+    failed = [
+        name
+        for name, pattern, extract, unit, better in gates
+        if not check(name, load_series(pattern, extract), unit, better)
+    ]
+    if failed:
+        # name the offending series so the failure is actionable straight
+        # from the CI log, without rereading every trajectory above
         print(
-            f"trend: latest round regressed >"
+            f"trend: {', '.join(failed)} regressed >"
             f"{REGRESSION_TOLERANCE:.0%} against the best prior round"
         )
         return 1

@@ -687,3 +687,37 @@ def test_staging_audit_covers_doubling_cold_path(tmp_path):
     assert clean.errors == []
     assert [f.location() for f in clean.new] == []
     assert clean.files_checked == 1
+
+
+def test_staging_audit_covers_batched_dispatch_path(tmp_path):
+    """ISSUE 9: the round-batched dispatch path (tpu/dispatch.py staging
+    through GridStager, tpu/sharded.py 2-D fame loop) must stay inside
+    the jax-host-sync audit scope. A host-sync violation seeded into a
+    scratch copy of the REAL sharded module's shard_map factory must
+    fire, and the checked-in dispatch + sharded modules themselves must
+    stay clean with the (empty) shipped baseline — i.e. the batched path
+    added no new host syncs."""
+    real = Path(REPO_ROOT) / "babble_tpu" / "tpu" / "sharded.py"
+    src = real.read_text()
+    seeded = src + (
+        "\n\ndef _seeded_factory(mesh):\n"
+        "    def _seeded_local(votes):\n"
+        "        return int(votes[0, 0])\n"
+        "    return _shard_map(\n"
+        "        _seeded_local, mesh=mesh, in_specs=P(), out_specs=P()\n"
+        "    )\n"
+    )
+    p = tmp_path / "babble_tpu" / "tpu" / "sharded.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(seeded)
+    found = _lint(tmp_path).new
+    assert [(f.rule, f.symbol) for f in found] == [
+        ("jax-host-sync", "_seeded_local")
+    ]
+    assert found[0].line > len(src.splitlines())
+
+    for mod in ("babble_tpu/tpu/sharded.py", "babble_tpu/tpu/dispatch.py"):
+        clean = run_lint(REPO_ROOT, paths=[mod], baseline_path=None)
+        assert clean.errors == []
+        assert [f.location() for f in clean.new] == [], mod
+        assert clean.files_checked == 1

@@ -231,3 +231,185 @@ def test_dryrun_multichip_driver_env():
     )
     assert proc.returncode == 0, proc.stderr
     assert "dryrun_multichip OK" in proc.stdout
+
+
+# -- 2-D (validators, rounds) mesh (ISSUE 9) ---------------------------------
+
+
+def make_mesh2(dv, dr):
+    devices = jax.devices("cpu")
+    if len(devices) < dv * dr:
+        pytest.skip(f"need {dv * dr} CPU devices, have {len(devices)}")
+    return Mesh(
+        np.array(devices[: dv * dr]).reshape(dv, dr), ("validators", "rounds")
+    )
+
+
+def assert_2d_matches(grid, dv=2, dr=2):
+    """Every sharded pipeline on the 2-D mesh must be byte-equal to the
+    single-device oracle — the validator-axis partition of the voting
+    state (per-shard local tallies + one psum per fame step) is an
+    implementation layout, never an observable."""
+    from babble_tpu.tpu.engine import run_frontier_passes
+    from babble_tpu.tpu.sharded import (
+        mesh_validator_shards, sharded_frontier_passes, sharded_run_passes,
+    )
+
+    mesh = make_mesh2(dv, dr)
+    assert mesh_validator_shards(mesh) == dv
+
+    single = run_passes(grid)
+    sharded = sharded_run_passes(mesh, grid)
+    np.testing.assert_array_equal(sharded.rounds, single.rounds)
+    np.testing.assert_array_equal(sharded.witness, single.witness)
+    np.testing.assert_array_equal(sharded.lamport, single.lamport)
+    np.testing.assert_array_equal(sharded.fame_decided, single.fame_decided)
+    np.testing.assert_array_equal(
+        sharded.famous & sharded.fame_decided,
+        single.famous & single.fame_decided,
+    )
+    np.testing.assert_array_equal(sharded.rounds_decided, single.rounds_decided)
+    np.testing.assert_array_equal(sharded.received, single.received)
+    assert sharded.last_round == single.last_round
+
+    single_f = run_frontier_passes(grid)
+    sf = sharded_frontier_passes(mesh, grid)
+    np.testing.assert_array_equal(sf.rounds, single_f.rounds)
+    np.testing.assert_array_equal(sf.received, single_f.received)
+    assert sf.last_round == single_f.last_round
+    r = min(sf.fame_decided.shape[0], single_f.fame_decided.shape[0])
+    np.testing.assert_array_equal(sf.fame_decided[:r], single_f.fame_decided[:r])
+    np.testing.assert_array_equal(
+        (sf.famous & sf.fame_decided)[:r],
+        (single_f.famous & single_f.fame_decided)[:r],
+    )
+
+
+def test_2d_mesh_synthetic_differential():
+    assert_2d_matches(synthetic_grid(8, 192, seed=11))
+
+
+def test_2d_mesh_witness_padding():
+    """Validator count not divisible by the validator shards: the
+    witness axes pad to a multiple of dv (padded strongly-seen columns
+    are False so padded vote rows tally zero)."""
+    assert_2d_matches(synthetic_grid(7, 128, seed=9))
+
+
+def test_2d_mesh_fixture_differential():
+    hg, _, _ = init_consensus_hashgraph()
+    assert_2d_matches(grid_from_hashgraph(hg))
+
+
+def test_2d_mesh_post_reset_section():
+    """Acceptance: 2-D outputs byte-equal on post-reset sections too."""
+    from babble_tpu.tpu.grid import section_grid
+
+    grid = synthetic_grid(8, 192, seed=11)
+    res = run_passes(grid)
+    sec = section_grid(grid, res, cut=4)
+    assert_2d_matches(sec)
+
+
+def test_2d_mesh_doubling_cold_path():
+    """The sharded pointer-doubling pipeline (the round-batched rung's
+    cold path) on the 2-D mesh, vs the frontier oracle."""
+    from babble_tpu.tpu.engine import run_frontier_passes
+    from babble_tpu.tpu.sharded import sharded_doubling_passes
+
+    grid = synthetic_grid(8, 192, seed=11)
+    mesh = make_mesh2(2, 2)
+    sd = sharded_doubling_passes(mesh, grid)
+    single = run_frontier_passes(grid)
+    np.testing.assert_array_equal(sd.rounds, single.rounds)
+    np.testing.assert_array_equal(sd.received, single.received)
+    assert sd.last_round == single.last_round
+
+
+# -- delta staging (GridStager, ISSUE 9) -------------------------------------
+
+
+def test_grid_stager_incremental_matches_full_restage():
+    """Replay the consensus fixture's event stream into a fresh
+    hashgraph a few events at a time; after every chunk the persistent
+    stager's grid must be byte-equal to a from-scratch
+    grid_from_hashgraph on every column — delta staging is a pure
+    restage eliminator, never an observable."""
+    from babble_tpu.hashgraph import Hashgraph, InmemStore
+    from babble_tpu.tpu.grid import GridStager
+
+    from dsl import CACHE_SIZE
+
+    src, _, ordered = init_consensus_hashgraph()
+    hg = Hashgraph(
+        src.participants, InmemStore(src.participants, CACHE_SIZE)
+    )
+    stager = GridStager(hg)
+    CHUNK = 3
+    for lo in range(0, len(ordered), CHUNK):
+        for ev in ordered[lo : lo + CHUNK]:
+            hg.insert_event(ev, True)
+        got = stager.stage()
+        want = grid_from_hashgraph(hg)
+        assert got.e == want.e
+        assert got.num_levels == want.num_levels
+        for col in (
+            "creator", "index", "self_parent", "other_parent",
+            "last_ancestors", "first_descendants",
+            "ext_sp_round", "ext_op_round", "fixed_round",
+            "ext_sp_lamport", "ext_op_lamport", "fixed_lamport",
+            "coin_bit",
+        ):
+            np.testing.assert_array_equal(
+                getattr(got, col)[: got.e], getattr(want, col)[: want.e],
+                err_msg=f"stager column {col} diverged at e={got.e}",
+            )
+        for lv in range(want.num_levels):
+            np.testing.assert_array_equal(
+                np.sort(got.levels[lv][got.levels[lv] >= 0]),
+                np.sort(want.levels[lv][want.levels[lv] >= 0]),
+                err_msg=f"stager level {lv} diverged at e={got.e}",
+            )
+        assert list(got.hashes) == list(want.hashes)
+    assert stager.full_restages == 1, "delta path never took over"
+    assert stager.delta_stages > 0
+    last_chunk = len(ordered) - ((len(ordered) - 1) // CHUNK) * CHUNK
+    assert stager.last_delta_rows == last_chunk
+
+
+def test_grid_stager_snapshots_are_immutable():
+    """A staged snapshot handed to an in-flight dispatch must not change
+    under later inserts (first_descendants and levels mutate in the
+    stager's resident buffers — snapshots copy them)."""
+    from babble_tpu.hashgraph import Hashgraph, InmemStore
+    from babble_tpu.tpu.grid import GridStager
+
+    from dsl import CACHE_SIZE
+
+    src, _, ordered = init_consensus_hashgraph()
+    hg = Hashgraph(
+        src.participants, InmemStore(src.participants, CACHE_SIZE)
+    )
+    stager = GridStager(hg)
+    half = len(ordered) // 2
+    for ev in ordered[:half]:
+        hg.insert_event(ev, True)
+    snap = stager.stage()
+    fd_before = snap.first_descendants.copy()
+    levels_before = snap.levels.copy()
+    for ev in ordered[half:]:
+        hg.insert_event(ev, True)
+    stager.stage()
+    np.testing.assert_array_equal(snap.first_descendants, fd_before)
+    np.testing.assert_array_equal(snap.levels, levels_before)
+
+
+def test_use_doubling_prefer_lowers_crossover():
+    """The round-batched rung prefers the doubling cold path well below
+    the per-sync crossover: one dispatch per batch amortizes the train."""
+    from babble_tpu.tpu.doubling import use_doubling
+
+    grid = synthetic_grid(8, 512, seed=3)
+    assert grid.num_levels >= 64, "fixture too shallow for the assertion"
+    assert not use_doubling(grid)
+    assert use_doubling(grid, prefer=True)
