@@ -4,10 +4,20 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint bench bench-mesh trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint race bench bench-mesh trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# concurrency certification (ISSUE 12, docs/analysis.md): the full tier-1
+# suite under lockset/lock-order instrumentation (BABBLE_RACE_CERTIFY=1
+# wraps the session in analysis/lockruntime.certify()), then the 50-seed
+# sim sweep under the same instrumentation via the lint CLI. Zero race
+# candidates and an acyclic lock graph are the acceptance bar.
+RACE_SEEDS ?= 50
+race:
+	BABBLE_RACE_CERTIFY=1 $(PY) -m pytest tests/ -q -m 'not slow'
+	$(PY) -m babble_tpu lint --races --race-seeds $(RACE_SEEDS)
 
 # consensus-grade static analysis (babble_tpu/analysis/, docs/analysis.md):
 # determinism lint + lock-discipline checker + JAX staging audit +

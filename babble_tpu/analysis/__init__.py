@@ -1,15 +1,23 @@
-"""Consensus-grade static analysis (docs/analysis.md).
+"""Consensus-grade static analysis and concurrency certification
+(docs/analysis.md).
 
-Four AST checker families over the package source:
+Five AST checker families over the package source:
 
 - determinism lint (determinism.py): wall-clock/RNG/set-order/hash()
   nondeterminism that would diverge replicas computing the same DAG;
 - lock-discipline checker (locks.py): `# guarded-by:` race detection for
-  shared attributes in the threaded node/net/proxy runtime;
+  shared attributes in the threaded node/net/obs/dispatch runtime;
+- guarded-by inference + dead-waiver audit (races.py): unannotated
+  shared mutable state, annotations the mutation sites contradict, and
+  waivers/declarations that no longer suppress or describe anything;
 - JAX staging audit (staging.py): tracer-hostile Python inside
   `jax.jit`-staged device kernels;
 - observability lint (obs.py): metric declarations must use static
   string names and literal, bounded label sets (`obs-*` rules).
+
+Plus the dynamic half (lockruntime.py): an Eraser-style lockset race
+detector and a lock-order deadlock analyzer over instrumented runs —
+`certify()` scopes, `babble-tpu lint --races`, `make race`.
 
 Run via `babble-tpu lint` / `make lint`; the checked-in baseline
 (baseline.json) pins accepted findings so the gate stays green while
@@ -21,7 +29,14 @@ correctness story.
 from .core import Finding, SourceFile, load_baseline, write_baseline
 from .determinism import check_determinism
 from .locks import check_locks
+from .lockruntime import (
+    RaceCertifier,
+    active_certifier,
+    certify,
+    run_race_certification,
+)
 from .obs import check_obs
+from .races import check_dead_waivers, check_races
 from .runner import LintResult, format_report, lint_file, main, run_lint
 from .staging import check_staging, find_staged_functions
 
@@ -29,9 +44,14 @@ __all__ = [
     "Finding",
     "SourceFile",
     "LintResult",
+    "RaceCertifier",
+    "active_certifier",
+    "certify",
+    "check_dead_waivers",
     "check_determinism",
     "check_locks",
     "check_obs",
+    "check_races",
     "check_staging",
     "find_staged_functions",
     "format_report",
@@ -39,5 +59,6 @@ __all__ = [
     "load_baseline",
     "main",
     "run_lint",
+    "run_race_certification",
     "write_baseline",
 ]

@@ -69,6 +69,10 @@ class SourceFile:
     # line -> full comment text ("# ..." stripped of the leading hash)
     comments: Dict[int, str] = field(default_factory=dict)
     lines: List[str] = field(default_factory=list)
+    # comment lines whose waiver/annotation actually did something this
+    # run — consumed a finding, declared a guard that a checker used.
+    # The dead-waiver rule (races.check_dead_waivers) flags the rest.
+    used_waiver_lines: set = field(default_factory=set)
 
     @classmethod
     def parse(cls, abspath: str, relpath: str) -> "SourceFile":
@@ -95,26 +99,52 @@ class SourceFile:
             return self.lines[line - 1]
         return ""
 
-    def comment_on_or_above(self, line: int) -> List[str]:
-        out = []
+    def _comments_on_or_above(self, line: int) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
         for ln in (line, line - 1):
             c = self.comments.get(ln)
             if c is not None:
                 # a comment on the line above only counts if that line is
                 # comment-only (a trailing comment there waives ITS line)
                 if ln == line or self.line_text(ln).lstrip().startswith("#"):
-                    out.append(c)
+                    out.append((ln, c))
         return out
+
+    def comment_on_or_above(self, line: int) -> List[str]:
+        return [c for _, c in self._comments_on_or_above(line)]
+
+    def comment_block_above(self, line: int) -> List[Tuple[int, str]]:
+        """The trailing comment on `line` plus the contiguous run of
+        comment-only lines directly above it, nearest first. Used by the
+        annotation collectors so a `# guarded-by:` declaration may sit in
+        a multi-line comment block above the introducing assignment."""
+        out: List[Tuple[int, str]] = []
+        c = self.comments.get(line)
+        if c is not None:
+            out.append((line, c))
+        ln = line - 1
+        while ln >= 1:
+            c = self.comments.get(ln)
+            if c is None or not self.line_text(ln).lstrip().startswith("#"):
+                break
+            out.append((ln, c))
+            ln -= 1
+        return out
+
+    def mark_waiver_used(self, line: int) -> None:
+        self.used_waiver_lines.add(line)
 
     def has_waiver(self, line: int, tag: str) -> bool:
         """True when `# <tag>: <reason>` (or `# lint-ok: <reason>`) sits on
         the line or on a comment-only line directly above. The reason is
-        mandatory: a tag with nothing after the colon does not waive."""
-        for c in self.comment_on_or_above(line):
+        mandatory: a tag with nothing after the colon does not waive.
+        A match marks the comment line *used* for the dead-waiver rule."""
+        for ln, c in self._comments_on_or_above(line):
             for t in (tag, GENERIC_WAIVER):
                 if c.startswith(t):
                     rest = c[len(t):]
                     if rest.startswith(":") and rest[1:].strip():
+                        self.mark_waiver_used(ln)
                         return True
         return False
 

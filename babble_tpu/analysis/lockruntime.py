@@ -1,0 +1,635 @@
+"""Dynamic concurrency certification: Eraser-style lockset race detection
+and lock-order (deadlock) analysis (docs/analysis.md, ISSUE 12).
+
+The static checkers prove lexical discipline; this module checks the
+*runtime* story inside a `certify()` scope:
+
+- **Lockset (race) detection.** Every class that declares `# guarded-by:`
+  annotations (or assigns a lock to `self`) in the certified modules gets
+  its `__setattr__`/`__getattribute__` patched so guarded-field accesses
+  are observed, and every `threading.Lock`/`RLock`/`Condition` assigned
+  to such a class (plus the registered module-level locks) is wrapped in
+  an instrumented shim. Each shared field then carries a candidate
+  lockset C(v) — the set of locks held at every cross-thread access —
+  intersected per access (the Eraser algorithm). A field in the
+  shared-modified state whose lockset goes empty is a `race.candidate`
+  finding. Fields with a statically waived (deliberately racy) access
+  site are certified statically only and skipped here, so a waiver keeps
+  one meaning across both passes.
+- **Lock-order analysis.** Each acquisition records edges from every
+  lock currently held by the thread to the one being acquired, keyed by
+  role name (`Class.attr` / module-level name) so instances aggregate.
+  A cycle in that graph — A→B somewhere, B→A elsewhere — is a
+  `lockorder.cycle` finding even if no run ever interleaved into the
+  actual deadlock. Nested acquisitions of two same-named locks on
+  *different* instances are not recorded (per-instance ordering is out
+  of scope); re-acquiring one non-reentrant lock would deadlock the run
+  itself, which is its own detector.
+
+No global monkeypatching: only the classes/locks named by annotations in
+the certified modules are touched, `certify()` restores every patched
+class and module lock on exit, and production code paths never import
+this module. Findings feed attached flight recorders as `race.candidate`
+/ `lockorder.cycle` records with deterministic fields only (class, field
+and lock names — never thread ids), so a failing certification run
+exports triage artifacts exactly like a divergence failure does, and a
+clean run leaves every record stream byte-identical to an uninstrumented
+one.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import threading
+import weakref
+from contextlib import contextmanager
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import SourceFile, import_aliases
+from .locks import WAIVER, _self_attr, collect_guard_decls, merged_guard_decls
+from .races import _module_lock_names, class_concurrency
+
+# modules whose annotated classes are instrumented by default: every file
+# in the lock-discipline scope that defines guarded state
+DEFAULT_MODULES: Tuple[str, ...] = (
+    "babble_tpu.obs.metrics",
+    "babble_tpu.obs.flightrec",
+    "babble_tpu.obs.slo",
+    "babble_tpu.obs.trace",
+    "babble_tpu.obs.tracectx",
+    "babble_tpu.node.node",
+    "babble_tpu.node.state",
+    "babble_tpu.node.watchdog",
+    "babble_tpu.node.control_timer",
+    "babble_tpu.net.tcp_transport",
+    "babble_tpu.net.inmem_transport",
+    "babble_tpu.peers.peers",
+    "babble_tpu.peers.json_peers",
+    "babble_tpu.proxy.jsonrpc",
+    "babble_tpu.proxy.dummy",
+    "babble_tpu.service",
+    "babble_tpu.tpu.dispatch",
+    "babble_tpu.tpu.live",
+)
+
+# module-level locks wrapped for lock-order coverage: their ordering vs
+# the instance locks is convention-only in the source, which is exactly
+# what the acquisition graph certifies
+DEFAULT_GLOBAL_LOCKS: Tuple[Tuple[str, str], ...] = (
+    ("babble_tpu.tpu.dispatch", "_MESH_EXEC_LOCK"),
+    ("babble_tpu.service", "_profile_lock"),
+)
+
+_RAW_LOCK_TYPES = (
+    type(threading.Lock()),
+    type(threading.RLock()),
+)
+
+
+class RaceCertificationError(AssertionError):
+    """Raised by strict certification scopes on findings."""
+
+
+class _InstrumentedBase:
+    """Shared shim plumbing: delegation plus acquire/release bookkeeping.
+
+    Reentrancy is counted per thread so an RLock's nested acquires add
+    one held entry (and one lock-order edge), not one per level.
+    """
+
+    def __init__(self, raw: Any, name: str, cert: "RaceCertifier") -> None:
+        self._raw = raw
+        self._cert_name = name
+        self._cert = cert
+        self._depth = threading.local()
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _enter_held(self) -> None:
+        d = getattr(self._depth, "n", 0)
+        self._depth.n = d + 1
+        if d == 0:
+            self._cert._note_acquire(self)
+
+    def _exit_held(self) -> None:
+        d = getattr(self._depth, "n", 0)
+        if d <= 1:
+            self._depth.n = 0
+            self._cert._note_release(self)
+        else:
+            self._depth.n = d - 1
+
+    # -- lock interface ---------------------------------------------------
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        ok = self._raw.acquire(*args, **kwargs)
+        if ok:
+            self._enter_held()
+        return ok
+
+    def release(self) -> None:
+        self._exit_held()
+        self._raw.release()
+
+    def __enter__(self) -> "_InstrumentedBase":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __repr__(self) -> str:
+        return f"<certified {self._cert_name} wrapping {self._raw!r}>"
+
+
+class InstrumentedLock(_InstrumentedBase):
+    """Instrumented `threading.Lock`/`RLock` stand-in."""
+
+
+class InstrumentedCondition(_InstrumentedBase):
+    """Instrumented `threading.Condition` stand-in: `wait` releases the
+    underlying lock, so held bookkeeping steps out for the wait and back
+    in on wakeup."""
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._exit_held()
+        try:
+            return self._raw.wait(timeout)
+        finally:
+            self._enter_held()
+
+    def wait_for(self, predicate: Any, timeout: Optional[float] = None) -> Any:
+        self._exit_held()
+        try:
+            return self._raw.wait_for(predicate, timeout)
+        finally:
+            self._enter_held()
+
+    def notify(self, n: int = 1) -> None:
+        self._raw.notify(n)
+
+    def notify_all(self) -> None:
+        self._raw.notify_all()
+
+
+# Eraser field states
+_EXCLUSIVE = 0       # one thread has ever touched it
+_SHARED = 1          # read by a second thread; reads alone don't report
+_SHARED_MOD = 2      # written while shared; empty lockset = candidate
+
+
+class _Shadow:
+    __slots__ = ("state", "owner", "lockset")
+
+    def __init__(self, owner: int) -> None:
+        self.state = _EXCLUSIVE
+        self.owner = owner
+        self.lockset: Optional[FrozenSet[int]] = None
+
+
+class RaceCertifier:
+    """One certification scope: findings, held-lock stacks, the Eraser
+    shadow store and the lock-order graph. Created via `certify()`."""
+
+    def __init__(self) -> None:
+        self.findings: List[Dict[str, Any]] = []
+        self.recorders: List[Any] = []  # FlightRecorder-compatible
+        self._active = False
+        # leaf lock guarding shadows/graph/findings; recorder emission
+        # happens OUTSIDE it under the _busy reentrancy guard, because a
+        # recorder's own (instrumented) lock must not nest inside it
+        self._meta = threading.Lock()
+        self._busy = threading.local()
+        self._held = threading.local()  # per-thread stack of wrappers
+        self._shadows: Dict[Tuple[int, str], _Shadow] = {}
+        self._finalized: Set[int] = set()
+        # oids whose object died, pending shadow cleanup. Appended by GC
+        # finalizers WITHOUT taking _meta (a finalizer can fire inside a
+        # _meta critical section — any allocation can trigger GC — and
+        # taking the non-reentrant lock there would self-deadlock);
+        # drained at the next _note_field while _meta is held
+        self._dead: List[int] = []
+        self._reported: Set[Tuple[str, str]] = set()
+        # lock-order graph: name -> names acquired while it was held
+        self._edges: Dict[str, Set[str]] = {}
+        self._patched: List[Tuple[type, bool, Any, bool, Any]] = []
+        self._globals: List[Tuple[Any, str, Any]] = []
+        self._cycles_found: Set[Tuple[str, ...]] = set()
+
+    # ------------------------------------------------------------------
+    # lock bookkeeping (called from instrumented shims)
+    # ------------------------------------------------------------------
+
+    def _stack(self) -> List[_InstrumentedBase]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _note_acquire(self, lock: _InstrumentedBase) -> None:
+        if getattr(self._busy, "on", False):
+            return
+        st = self._stack()
+        if self._active and st:
+            with self._meta:
+                for held in st:
+                    if held is lock or held._cert_name == lock._cert_name:
+                        # same instance (reentrant) or two instances in
+                        # the same role: per-instance ordering is out of
+                        # scope (see module docstring)
+                        continue
+                    self._edges.setdefault(
+                        held._cert_name, set()
+                    ).add(lock._cert_name)
+        st.append(lock)
+
+    def _note_release(self, lock: _InstrumentedBase) -> None:
+        if getattr(self._busy, "on", False):
+            return
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                break
+
+    # ------------------------------------------------------------------
+    # field bookkeeping (called from patched class dunders)
+    # ------------------------------------------------------------------
+
+    def _note_field(self, obj: Any, cls: type, field: str, lock_name: str,
+                    write: bool) -> None:
+        if not self._active or getattr(self._busy, "on", False):
+            return
+        # only track instances whose declared lock is instrumented: an
+        # object built outside the certify scope carries raw locks, and
+        # its (invisible to us) holds would read as empty locksets
+        try:
+            declared = object.__getattribute__(obj, lock_name)
+        except AttributeError:
+            return  # __init__ hasn't bound the lock yet
+        if not isinstance(declared, _InstrumentedBase) or declared._cert is not self:
+            # raw lock (pre-scope object) or another — nested — scope's
+            # wrapper: its holds are invisible here, so tracking it would
+            # misread properly locked accesses as empty locksets
+            return
+        tid = threading.get_ident()
+        held = frozenset(id(w) for w in self._stack())
+        oid = id(obj)
+        key = (oid, field)
+        emit: Optional[Dict[str, Any]] = None
+        with self._meta:
+            if self._dead:
+                self._drain_dead_locked()
+            sh = self._shadows.get(key)
+            if sh is None:
+                self._shadows[key] = _Shadow(tid)
+                if oid not in self._finalized:
+                    self._finalized.add(oid)
+                    try:
+                        # id() values recycle after GC; dropping the dead
+                        # object's shadows keeps a recycled id from
+                        # inheriting a stale (possibly empty) lockset
+                        weakref.finalize(obj, self._forget, oid)
+                    except TypeError:
+                        pass  # not weakref-able: accept the small risk
+            elif sh.state == _EXCLUSIVE:
+                if tid != sh.owner:
+                    sh.state = _SHARED_MOD if write else _SHARED
+                    sh.lockset = held
+                    if sh.state == _SHARED_MOD and not held:
+                        emit = self._report_race(cls, field, lock_name,
+                                                 "write")
+            else:
+                assert sh.lockset is not None
+                sh.lockset = sh.lockset & held
+                if write and sh.state == _SHARED:
+                    sh.state = _SHARED_MOD
+                if sh.state == _SHARED_MOD and not sh.lockset:
+                    emit = self._report_race(
+                        cls, field, lock_name, "write" if write else "read"
+                    )
+        if emit is not None:
+            self._emit(emit)
+
+    def _forget(self, oid: int) -> None:
+        # GC-finalizer context: lock-free by design (list.append is
+        # GIL-atomic); see _dead above
+        self._dead.append(oid)
+
+    def _drain_dead_locked(self) -> None:  # requires-lock: _meta
+        while self._dead:
+            oid = self._dead.pop()
+            self._finalized.discard(oid)
+            for key in [k for k in self._shadows if k[0] == oid]:
+                del self._shadows[key]
+
+    def _report_race(self, cls: type, field: str, lock_name: str,
+                     access: str) -> Optional[Dict[str, Any]]:  # requires-lock: _meta
+        dedupe = (cls.__name__, field)
+        if dedupe in self._reported:
+            return None
+        self._reported.add(dedupe)
+        finding = {
+            "kind": "race.candidate",
+            "cls": cls.__name__,
+            "field": field,
+            "lock": lock_name,
+            "access": access,
+        }
+        self.findings.append(finding)
+        return finding
+
+    def _emit(self, finding: Dict[str, Any]) -> None:
+        """Feed one finding to the attached flight recorders. Runs under
+        the _busy guard: the recorders' own locks and guarded fields must
+        not feed back into certification bookkeeping."""
+        self._busy.on = True
+        try:
+            for rec in self.recorders:
+                if finding["kind"] == "race.candidate":
+                    rec.record("race.candidate", cls=finding["cls"],
+                               field=finding["field"], lock=finding["lock"],
+                               access=finding["access"])
+                else:
+                    rec.record("lockorder.cycle", cycle=finding["cycle"])
+        finally:
+            self._busy.on = False
+
+    # ------------------------------------------------------------------
+    # lock-order analysis
+    # ------------------------------------------------------------------
+
+    def check_lock_order(self) -> List[Dict[str, Any]]:
+        """DFS the acquisition graph for cycles; new cycles append
+        `lockorder.cycle` findings. Called on certify() scope exit and
+        after every certified sim run; idempotent per distinct cycle."""
+        with self._meta:
+            edges = {k: sorted(v) for k, v in self._edges.items()}
+        new: List[Dict[str, Any]] = []
+        state: Dict[str, int] = {}  # 0 unvisited / 1 on-path / 2 done
+        path: List[str] = []
+
+        def visit(node: str) -> None:
+            state[node] = 1
+            path.append(node)
+            for nxt in edges.get(node, ()):
+                if state.get(nxt, 0) == 1:
+                    body = tuple(path[path.index(nxt):])
+                    # canonical rotation so A->B->A and B->A->B dedupe
+                    lo = body.index(min(body))
+                    canon = body[lo:] + body[:lo]
+                    if canon not in self._cycles_found:
+                        self._cycles_found.add(canon)
+                        new.append({
+                            "kind": "lockorder.cycle",
+                            "cycle": " -> ".join(canon + (canon[0],)),
+                        })
+                elif state.get(nxt, 0) == 0:
+                    visit(nxt)
+            path.pop()
+            state[node] = 2
+
+        for node in sorted(edges):
+            if state.get(node, 0) == 0:
+                visit(node)
+        if new:
+            with self._meta:
+                self.findings.extend(new)
+            for finding in new:
+                self._emit(finding)
+        return new
+
+    def lock_order_edges(self) -> Dict[str, List[str]]:
+        with self._meta:
+            return {k: sorted(v) for k, v in self._edges.items()}
+
+    # ------------------------------------------------------------------
+    # install / uninstall
+    # ------------------------------------------------------------------
+
+    def attach_recorder(self, recorder: Any) -> None:
+        if recorder not in self.recorders:
+            self.recorders.append(recorder)
+
+    def detach_recorder(self, recorder: Any) -> None:
+        try:
+            self.recorders.remove(recorder)
+        except ValueError:
+            pass
+
+    def _wrap_lock(self, raw: Any, name: str) -> _InstrumentedBase:
+        if isinstance(raw, _InstrumentedBase):
+            return raw
+        if isinstance(raw, threading.Condition):
+            return InstrumentedCondition(raw, name, self)
+        return InstrumentedLock(raw, name, self)
+
+    def _patch_class(self, cls: type, guarded: Dict[str, str]) -> None:
+        had_set = "__setattr__" in cls.__dict__
+        orig_set = cls.__setattr__
+        had_get = "__getattribute__" in cls.__dict__
+        orig_get = cls.__getattribute__
+        cert = self
+
+        def patched_setattr(obj: Any, name: str, value: Any,
+                            _cls: type = cls) -> None:
+            if isinstance(value, _RAW_LOCK_TYPES) or isinstance(
+                value, threading.Condition
+            ):
+                value = cert._wrap_lock(value, f"{_cls.__name__}.{name}")
+            lock_name = guarded.get(name)
+            if lock_name is not None:
+                cert._note_field(obj, _cls, name, lock_name, write=True)
+            orig_set(obj, name, value)
+
+        def patched_getattribute(obj: Any, name: str,
+                                 _cls: type = cls) -> Any:
+            value = orig_get(obj, name)
+            lock_name = guarded.get(name)
+            if lock_name is not None:
+                cert._note_field(obj, _cls, name, lock_name, write=False)
+            return value
+
+        cls.__setattr__ = patched_setattr  # type: ignore[method-assign]
+        cls.__getattribute__ = patched_getattribute  # type: ignore[method-assign]
+        self._patched.append((cls, had_set, orig_set, had_get, orig_get))
+
+    def _unpatch_classes(self) -> None:
+        for cls, had_set, orig_set, had_get, orig_get in self._patched:
+            if had_set:
+                cls.__setattr__ = orig_set  # type: ignore[method-assign]
+            else:
+                del cls.__setattr__
+            if had_get:
+                cls.__getattribute__ = orig_get  # type: ignore[method-assign]
+            else:
+                del cls.__getattribute__
+        self._patched.clear()
+
+    def _wrap_global(self, module: Any, var: str) -> None:
+        raw = getattr(module, var, None)
+        if raw is None or isinstance(raw, _InstrumentedBase):
+            return
+        setattr(module, var, self._wrap_lock(raw, var))
+        self._globals.append((module, var, raw))
+
+    def _unwrap_globals(self) -> None:
+        for module, var, raw in self._globals:
+            setattr(module, var, raw)
+        self._globals.clear()
+
+
+def _waived_attrs(sf: SourceFile, cls_node: ast.ClassDef) -> Set[str]:
+    """Fields with at least one `# unguarded-ok:` access site in the
+    class body: deliberately racy by declaration, so the dynamic pass
+    leaves them to the static waiver audit (see module docstring)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls_node):
+        attr = _self_attr(node)
+        if attr is not None and sf.has_waiver(node.lineno, WAIVER):
+            out.add(attr)
+    return out
+
+
+def _instrument_module(cert: RaceCertifier, module_name: str) -> None:
+    module = importlib.import_module(module_name)
+    src = getattr(module, "__file__", None)
+    if not src or not os.path.exists(src):
+        return
+    sf = SourceFile.parse(src, os.path.basename(src))
+    threading_aliases, member_aliases = import_aliases(sf.tree, "threading")
+    module_locks = _module_lock_names(sf, threading_aliases, member_aliases)
+    class_map = {
+        n.name: n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)
+    }
+    for cls_node in ast.walk(sf.tree):
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        own_decls = collect_guard_decls(sf, cls_node)
+        cc = class_concurrency(
+            cls_node, threading_aliases, member_aliases, module_locks
+        )
+        if not own_decls and not cc.self_locks:
+            continue
+        pycls = getattr(module, cls_node.name, None)
+        if not isinstance(pycls, type):
+            continue  # nested or re-exported elsewhere: out of scope
+        merged = merged_guard_decls(sf, cls_node, class_map)
+        waived = _waived_attrs(sf, cls_node)
+        guarded = {
+            attr: decl.lock for attr, decl in merged.items()
+            if attr not in waived
+        }
+        cert._patch_class(pycls, guarded)
+
+
+_ACTIVE_CERTIFIERS: List[RaceCertifier] = []
+
+
+def active_certifier() -> Optional[RaceCertifier]:
+    """The innermost live certify() scope, if any — the sim sweep asks
+    this to decide whether to collect race findings per seed."""
+    return _ACTIVE_CERTIFIERS[-1] if _ACTIVE_CERTIFIERS else None
+
+
+@contextmanager
+def certify(modules: Optional[Tuple[str, ...]] = None,
+            global_locks: Optional[Tuple[Tuple[str, str], ...]] = None,
+            recorders: Tuple[Any, ...] = (),
+            strict: bool = False):
+    """Instrument the annotated classes of `modules` (default: the whole
+    lock-discipline scope) and the given module-level locks; yield the
+    RaceCertifier; restore everything on exit. With `strict=True`, exit
+    raises RaceCertificationError when findings (including lock-order
+    cycles, checked on exit) exist."""
+    cert = RaceCertifier()
+    for rec in recorders:
+        cert.attach_recorder(rec)
+    mods = DEFAULT_MODULES if modules is None else tuple(modules)
+    globs = DEFAULT_GLOBAL_LOCKS if global_locks is None else tuple(global_locks)
+    try:
+        for m in mods:
+            _instrument_module(cert, m)
+        for mod_name, var in globs:
+            cert._wrap_global(importlib.import_module(mod_name), var)
+        cert._active = True
+        _ACTIVE_CERTIFIERS.append(cert)
+        try:
+            yield cert
+        finally:
+            _ACTIVE_CERTIFIERS.pop()
+            cert._active = False
+            cert.check_lock_order()
+    finally:
+        cert._unpatch_classes()
+        cert._unwrap_globals()
+    if strict and cert.findings:
+        raise RaceCertificationError(
+            f"{len(cert.findings)} concurrency finding(s): "
+            + "; ".join(format_finding(f) for f in cert.findings)
+        )
+
+
+def format_finding(f: Dict[str, Any]) -> str:
+    if f["kind"] == "race.candidate":
+        return (
+            f"race.candidate: {f['cls']}.{f['field']} (guarded-by "
+            f"{f['lock']}) {f['access']} with empty lockset"
+        )
+    return f"lockorder.cycle: {f['cycle']}"
+
+
+def run_race_certification(
+    seeds: int = 50,
+    n: int = 4,
+    plan: str = "clean",
+    target_block: Optional[int] = 3,
+    until: Optional[float] = 60.0,
+    artifact_dir: str = "docs/artifacts",
+    out=print,
+) -> int:
+    """`babble-tpu lint --races` / `make race`: run `seeds` seeded sims
+    under full instrumentation; non-zero exit on any race candidate,
+    lock-order cycle, or sim failure. Failing seeds export flight dumps
+    exactly like divergence failures do (sim/sweep.py)."""
+    from ..sim.sweep import run_one
+
+    failures: List[Tuple[int, str]] = []
+    with certify() as cert:
+        for seed in range(seeds):
+            before = len(cert.findings)
+            res = run_one(
+                seed, plan=plan, n=n, target_block=target_block,
+                until=until, artifact_dir=artifact_dir,
+            )
+            new = cert.findings[before:]
+            if not res["ok"]:
+                failures.append((seed, str(res["error"])))
+                dumps = res.get("flightrec") or []
+                out(f"race-certify seed {seed}: FAIL {res['error']}"
+                    + (f" ({len(dumps)} flight dump(s))" if dumps else ""))
+            elif new:
+                failures.append(
+                    (seed, "; ".join(format_finding(f) for f in new))
+                )
+                out(f"race-certify seed {seed}: FAIL "
+                    + "; ".join(format_finding(f) for f in new))
+            else:
+                out(f"race-certify seed {seed}: ok "
+                    f"({res['blocks_checked']} blocks)")
+    cycles = [f for f in cert.findings if f["kind"] == "lockorder.cycle"]
+    edges = cert.lock_order_edges()
+    out(
+        f"race certification: {seeds} seed(s), "
+        f"{len(cert.findings)} finding(s), "
+        f"{sum(len(v) for v in edges.values())} lock-order edge(s), "
+        f"{len(cycles)} cycle(s)"
+    )
+    for f in cert.findings:
+        out("  " + format_finding(f))
+    return 1 if (failures or cert.findings) else 0

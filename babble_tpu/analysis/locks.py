@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set
 
 from .core import Finding, SourceFile
 
@@ -50,17 +50,28 @@ def _self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _collect_annotations(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
-    """{attr: lock} from `# guarded-by:` trailing comments on `self.attr`
-    assignment lines anywhere in the class (class-body AnnAssigns too)."""
-    guarded: Dict[str, str] = {}
+class GuardDecl(NamedTuple):
+    """One `# guarded-by:` declaration: the lock name and the comment line
+    it lives on (for dead-waiver accounting)."""
+
+    lock: str
+    comment_line: int
+
+
+def collect_guard_decls(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, GuardDecl]:
+    """{attr: GuardDecl} from `# guarded-by:` comments trailing — or in the
+    contiguous comment block directly above — a `self.attr` assignment
+    anywhere in the class (class-body AnnAssigns too)."""
+    guarded: Dict[str, GuardDecl] = {}
     for node in ast.walk(cls):
         if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-            comment = sf.comments.get(node.lineno)
-            if not comment:
-                continue
-            m = _GUARDED_BY.search(comment)
-            if not m:
+            decl = None
+            for ln, comment in sf.comment_block_above(node.lineno):
+                m = _GUARDED_BY.search(comment)
+                if m:
+                    decl = GuardDecl(m.group(1), ln)
+                    break
+            if decl is None:
                 continue
             targets = (
                 node.targets if isinstance(node, ast.Assign) else [node.target]
@@ -70,8 +81,36 @@ def _collect_annotations(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
                 if attr is None and isinstance(t, ast.Name):
                     attr = t.id  # class-body declaration
                 if attr:
-                    guarded[attr] = m.group(1)
+                    guarded[attr] = decl
     return guarded
+
+
+def merged_guard_decls(
+    sf: SourceFile, cls: ast.ClassDef, class_map: Dict[str, ast.ClassDef]
+) -> Dict[str, GuardDecl]:
+    """Guard declarations for `cls` including those inherited from base
+    classes defined in the same file (e.g. `Counter`'s methods touching
+    `Metric._series`). Own declarations win over inherited ones; base
+    resolution is lexical and in-file only — cross-module inheritance is
+    out of scope, matching the checker's other limits."""
+    guarded: Dict[str, GuardDecl] = {}
+    seen: Set[str] = {cls.name}
+
+    def visit(c: ast.ClassDef) -> None:
+        for base in c.bases:
+            if isinstance(base, ast.Name) and base.id in class_map:
+                if base.id not in seen:
+                    seen.add(base.id)
+                    visit(class_map[base.id])
+        guarded.update(collect_guard_decls(sf, c))
+
+    visit(cls)
+    return guarded
+
+
+def _collect_annotations(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """{attr: lock} — compatibility shim over `collect_guard_decls`."""
+    return {a: d.lock for a, d in collect_guard_decls(sf, cls).items()}
 
 
 def _held_locks_for_with(item: ast.withitem) -> Optional[str]:
@@ -98,7 +137,7 @@ class _MethodWalker:
         sf: SourceFile,
         cls_name: str,
         fn: ast.FunctionDef,
-        guarded: Dict[str, str],
+        guarded: Dict[str, GuardDecl],
     ) -> None:
         self.sf = sf
         self.cls_name = cls_name
@@ -137,7 +176,10 @@ class _MethodWalker:
             return
         attr = _self_attr(node)
         if attr is not None and attr in self.guarded:
-            lock = self.guarded[attr]
+            decl = self.guarded[attr]
+            lock = decl.lock
+            # the declaration describes this access: it is a live comment
+            self.sf.mark_waiver_used(decl.comment_line)
             if lock not in held and not self.sf.has_waiver(node.lineno, WAIVER):
                 self.findings.append(
                     Finding(
@@ -160,10 +202,13 @@ class _MethodWalker:
 
 def check_locks(sf: SourceFile) -> Iterable[Finding]:
     findings: List[Finding] = []
+    class_map: Dict[str, ast.ClassDef] = {
+        n.name: n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)
+    }
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.ClassDef):
             continue
-        guarded = _collect_annotations(sf, node)
+        guarded = merged_guard_decls(sf, node, class_map)
         if not guarded:
             continue
         for item in node.body:

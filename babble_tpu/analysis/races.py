@@ -1,0 +1,426 @@
+"""Guarded-by inference pass and dead-waiver audit (docs/analysis.md).
+
+The lock-discipline checker (locks.py) verifies annotated fields. This
+module closes its blind spots:
+
+- `lock-unannotated`: in any class that owns concurrency (assigns a
+  `threading.Lock`/`RLock`/`Condition` to `self`, enters `with
+  self.<lock>:` / a module-level lock, or spawns a `threading.Thread`),
+  every `self._x` **mutation outside `__init__`** must belong to a field
+  that either carries a `# guarded-by:` declaration or is explicitly
+  `# unguarded-ok: <reason>` at its introduction. New fields can no
+  longer silently escape the checker.
+- `lock-infer-mismatch`: for annotated fields the pass derives the lock
+  actually held at every mutation site (the intersection of lexically
+  held locks); a non-empty inferred set that excludes the declared lock
+  means the annotation lies.
+- `lint-dead-waiver`: a reasoned waiver (`det-ok`/`unguarded-ok`/
+  `jax-ok`/`obs-ok`/`lint-ok`) or a `# guarded-by:` declaration that
+  suppressed or described nothing this run is itself a finding — stale
+  suppressions hide real regressions behind an authoritative-looking
+  comment. `# requires-lock:` is a contract, not a suppression, and is
+  never flagged.
+
+Inference is lexical, like the rest of the framework: `with self._lock:`
+and `with <module_lock>:` blocks plus `# requires-lock:` contracts
+establish the held set; aliasing is out of scope. Mutations are
+assignments/augassigns to `self.attr` (including `self._x[k] = v` and
+`self._x.y = v`), `del self.attr`, and calls of well-known mutator
+methods (`append`, `update`, `pop`, …) on `self.attr`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile
+from .locks import (
+    GuardDecl,
+    _requires_lock,
+    _self_attr,
+    collect_guard_decls,
+    merged_guard_decls,
+)
+
+WAIVER = "unguarded-ok"
+
+RULE_UNANNOTATED = "lock-unannotated"
+RULE_MISMATCH = "lock-infer-mismatch"
+RULE_DEAD_WAIVER = "lint-dead-waiver"
+
+# lock-like constructors: threading.X() / X() after `from threading import X`
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# methods that mutate their receiver in place — calling one on `self._x`
+# is a write to the shared structure behind `_x`
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "move_to_end", "rotate", "sort", "reverse",
+}
+
+_WAIVER_TAGS = ("det-ok", "unguarded-ok", "jax-ok", "obs-ok", "lint-ok")
+_REASONED_WAIVER = re.compile(
+    r"^(%s)\s*:\s*\S" % "|".join(_WAIVER_TAGS)
+)
+_GUARDED_BY_COMMENT = re.compile(r"^guarded-by:\s*[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _lock_factory_call(node: ast.AST, threading_aliases: Set[str],
+                       member_aliases: Dict[str, str]) -> bool:
+    """True for `threading.Lock()` / `Lock()` (via from-import) etc."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id in threading_aliases and fn.attr in LOCK_FACTORIES
+    if isinstance(fn, ast.Name):
+        return member_aliases.get(fn.id) in LOCK_FACTORIES
+    return False
+
+
+def _module_lock_names(sf: SourceFile, threading_aliases: Set[str],
+                       member_aliases: Dict[str, str]) -> Set[str]:
+    """Module-level names bound to a lock constructor (`_MESH_EXEC_LOCK =
+    threading.Lock()`)."""
+    names: Set[str] = set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and _lock_factory_call(
+            node.value, threading_aliases, member_aliases
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _mutation_target(node: ast.AST) -> Optional[str]:
+    """The `self.<attr>` a store/mutation ultimately lands on: handles
+    `self._x = v`, `self._x[k] = v`, `self._x.y = v` (one level deep is
+    enough — the base attr names the shared structure)."""
+    base = node
+    while isinstance(base, (ast.Subscript, ast.Attribute)):
+        attr = _self_attr(base)
+        if attr is not None:
+            return attr
+        base = base.value
+    return None
+
+
+class _ClassConcurrency:
+    """What makes one class concurrent, extracted in a single AST pass."""
+
+    def __init__(self) -> None:
+        self.self_locks: Set[str] = set()      # self attrs assigned a lock
+        self.with_self: Set[str] = set()       # attrs used as `with self.X:`
+        self.with_module: Set[str] = set()     # module locks used in `with`
+        self.spawns_thread: bool = False
+
+    @property
+    def lock_owner(self) -> bool:
+        return bool(
+            self.self_locks or self.with_self or self.with_module
+            or self.spawns_thread
+        )
+
+
+def class_concurrency(
+    cls: ast.ClassDef,
+    threading_aliases: Set[str],
+    member_aliases: Dict[str, str],
+    module_locks: Set[str],
+) -> _ClassConcurrency:
+    cc = _ClassConcurrency()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if value is not None and _lock_factory_call(
+                value, threading_aliases, member_aliases
+            ):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        cc.self_locks.add(attr)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx = item.context_expr
+                attr = _self_attr(ctx)
+                if attr is not None:
+                    cc.with_self.add(attr)
+                elif isinstance(ctx, ast.Name) and ctx.id in module_locks:
+                    cc.with_module.add(ctx.id)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "Thread"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in threading_aliases
+            ) or (
+                isinstance(fn, ast.Name)
+                and member_aliases.get(fn.id) == "Thread"
+            ):
+                cc.spawns_thread = True
+    return cc
+
+
+def _field_introductions(
+    sf: SourceFile, cls: ast.ClassDef
+) -> Dict[str, Tuple[int, bool]]:
+    """{attr: (introducing line, unguarded_ok)} — the first assignment to
+    `self.attr` (or a class-body Name target) in source order, and whether
+    its comment block carries a reasoned `# unguarded-ok:`. Introduction
+    waivers exempt the whole field from `lock-unannotated`."""
+    intro: Dict[str, Tuple[int, bool]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Name):
+                attr = t.id
+            if attr is None:
+                continue
+            waived = False
+            for ln, c in sf.comment_block_above(node.lineno):
+                if _REASONED_WAIVER.match(c) and c.startswith(WAIVER):
+                    waived = True
+                    sf.mark_waiver_used(ln)
+                    break
+            if attr not in intro or node.lineno < intro[attr][0]:
+                intro[attr] = (node.lineno, waived or intro.get(attr, (0, False))[1])
+            elif waived:
+                intro[attr] = (intro[attr][0], True)
+    return intro
+
+
+class _MutationSite:
+    __slots__ = ("line", "held", "method")
+
+    def __init__(self, line: int, held: Set[str], method: str) -> None:
+        self.line = line
+        self.held = held
+        self.method = method
+
+
+class _MutationWalker:
+    """Collect every `self.attr` mutation in one method with the lock set
+    lexically held at the site. Mirrors locks._MethodWalker's held-set
+    semantics: `with self.X:` and `with <module_lock>:` add to the set,
+    nested defs/lambdas reset it (modulo their own requires-lock)."""
+
+    def __init__(self, sf: SourceFile, fn: ast.FunctionDef,
+                 module_locks: Set[str]) -> None:
+        self.sf = sf
+        self.fn = fn
+        self.module_locks = module_locks
+        self.sites: Dict[str, List[_MutationSite]] = {}
+
+    def run(self) -> Dict[str, List[_MutationSite]]:
+        held = _requires_lock(self.sf, self.fn)
+        for stmt in self.fn.body:
+            self._walk(stmt, held)
+        return self.sites
+
+    def _record(self, attr: str, line: int, held: Set[str]) -> None:
+        self.sites.setdefault(attr, []).append(
+            _MutationSite(line, set(held), self.fn.name)
+        )
+
+    def _walk(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: Set[str] = set()
+            for item in node.items:
+                ctx = item.context_expr
+                attr = _self_attr(ctx)
+                if attr is not None:
+                    acquired.add(attr)
+                elif isinstance(ctx, ast.Name) and ctx.id in self.module_locks:
+                    acquired.add(ctx.id)
+                self._walk(ctx, held)
+            inner = held | acquired
+            for stmt in node.body:
+                self._walk(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner_held = _requires_lock(self.sf, node)
+            for stmt in node.body:
+                self._walk(stmt, inner_held)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, set())
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    attr = _mutation_target(e)
+                    if attr is not None:
+                        self._record(attr, e.lineno, held)
+            if node.value is not None:
+                self._walk(node.value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _mutation_target(t)
+                if attr is not None:
+                    self._record(attr, t.lineno, held)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in MUTATOR_METHODS
+            ):
+                attr = _self_attr(fn.value)
+                if attr is not None:
+                    self._record(attr, node.lineno, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+def check_races(sf: SourceFile) -> Iterable[Finding]:
+    """The inference pass: `lock-unannotated` + `lock-infer-mismatch`."""
+    from .core import import_aliases
+
+    threading_aliases, member_aliases = import_aliases(sf.tree, "threading")
+    module_locks = _module_lock_names(sf, threading_aliases, member_aliases)
+    findings: List[Finding] = []
+
+    class_map: Dict[str, ast.ClassDef] = {
+        n.name: n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)
+    }
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        cc = class_concurrency(cls, threading_aliases, member_aliases,
+                               module_locks)
+        if not cc.lock_owner:
+            continue
+        guarded = merged_guard_decls(sf, cls, class_map)
+        intro = _field_introductions(sf, cls)
+        lockish = (
+            cc.self_locks | cc.with_self | set(d.lock for d in guarded.values())
+        )
+
+        # gather mutation sites outside __init__ across all methods
+        sites: Dict[str, List[_MutationSite]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue  # happens-before: not yet shared
+            for attr, ss in _MutationWalker(sf, item, module_locks).run().items():
+                sites.setdefault(attr, []).extend(ss)
+
+        for attr in sorted(sites):
+            if attr in lockish or attr.endswith("_lock"):
+                continue  # the locks themselves are not shared data
+            live = [
+                s for s in sites[attr]
+                if not sf.has_waiver(s.line, WAIVER)
+            ]
+            if not live:
+                continue
+            inferred = set(live[0].held)
+            for s in live[1:]:
+                inferred &= s.held
+            first = min(live, key=lambda s: s.line)
+            if attr not in guarded:
+                if intro.get(attr, (0, False))[1]:
+                    continue  # `# unguarded-ok:` at the introduction
+                hint = (
+                    f" (inferred: {', '.join(sorted(inferred))})"
+                    if inferred else ""
+                )
+                findings.append(Finding(
+                    rule=RULE_UNANNOTATED,
+                    path=sf.path,
+                    line=first.line,
+                    message=(
+                        f"self.{attr} is mutated outside __init__ in a "
+                        f"class that owns concurrency, but carries no "
+                        f"`# guarded-by:` declaration{hint}; declare its "
+                        "lock at the introducing assignment or mark it "
+                        "`# unguarded-ok: <reason>` there"
+                    ),
+                    symbol=f"{cls.name}.{first.method}",
+                ))
+            else:
+                decl = guarded[attr]
+                if inferred and decl.lock not in inferred:
+                    findings.append(Finding(
+                        rule=RULE_MISMATCH,
+                        path=sf.path,
+                        line=first.line,
+                        message=(
+                            f"self.{attr} is declared guarded-by "
+                            f"{decl.lock}, but every mutation site holds "
+                            f"{{{', '.join(sorted(inferred))}}} instead; "
+                            "fix the annotation or the locking"
+                        ),
+                        symbol=f"{cls.name}.{first.method}",
+                    ))
+    return findings
+
+
+def check_dead_waivers(
+    sf: SourceFile, lock_scope: bool
+) -> Iterable[Finding]:
+    """`lint-dead-waiver`. MUST run after every other checker family on
+    this SourceFile: it audits `sf.used_waiver_lines`, which the other
+    checkers populate as they consume waivers and guard declarations.
+
+    - a reasoned waiver tag that suppressed no finding is dead;
+    - a `# guarded-by:` declaration that no checker matched to a shared
+      access is dead (in lock-scope files); outside the lock scope the
+      declaration is unenforced and therefore misleading — also dead.
+    """
+    findings: List[Finding] = []
+    for ln in sorted(sf.comments):
+        c = sf.comments[ln]
+        dead_reason = None
+        if _REASONED_WAIVER.match(c):
+            if ln not in sf.used_waiver_lines:
+                tag = c.split(":", 1)[0].strip()
+                dead_reason = (
+                    f"`# {tag}:` waiver suppresses no finding; the code it "
+                    "excused has moved or been fixed — delete the comment "
+                    "(stale waivers mask real regressions)"
+                )
+        elif _GUARDED_BY_COMMENT.match(c):
+            if not lock_scope:
+                dead_reason = (
+                    "`# guarded-by:` declaration in a file outside the "
+                    "lock-discipline scope: the contract is not enforced "
+                    "here — add the file to the scope or drop the comment"
+                )
+            elif ln not in sf.used_waiver_lines:
+                dead_reason = (
+                    "`# guarded-by:` declaration matches no shared access "
+                    "outside __init__ — either the field is never shared "
+                    "or the comment is not attached to its introducing "
+                    "assignment"
+                )
+        if dead_reason is not None:
+            findings.append(Finding(
+                rule=RULE_DEAD_WAIVER,
+                path=sf.path,
+                line=ln,
+                message=dead_reason,
+                symbol="",
+            ))
+    return findings

@@ -21,6 +21,7 @@ from .core import (
 from .determinism import check_determinism
 from .locks import check_locks
 from .obs import check_obs
+from .races import check_dead_waivers, check_races
 from .staging import check_staging
 
 # modules where replica-identical computation is decided: the five-pass
@@ -41,13 +42,19 @@ EXCLUDED_PREFIXES = (
     "babble_tpu/common/clock.py",
 )
 
-# modules whose shared state carries guarded-by annotations
+# modules whose shared state carries guarded-by annotations: the original
+# RPC/gossip/timer surfaces plus the threaded subsystems that grew after
+# the checker was first scoped — the mesh dispatch worker, the live-engine
+# async fetch, and the observability rings (ISSUE 12)
 LOCK_SCOPE_PREFIXES = (
     "babble_tpu/node/",
     "babble_tpu/net/",
     "babble_tpu/service.py",
     "babble_tpu/peers/",
     "babble_tpu/proxy/",
+    "babble_tpu/tpu/dispatch.py",
+    "babble_tpu/tpu/live.py",
+    "babble_tpu/obs/",
 )
 
 STAGING_SCOPE_PREFIXES = ("babble_tpu/tpu/",)
@@ -103,11 +110,41 @@ def lint_file(sf: SourceFile) -> List[Finding]:
         )
     )
     findings.extend(check_obs(sf))
-    if _matches(sf.path, LOCK_SCOPE_PREFIXES):
+    lock_scope = _matches(sf.path, LOCK_SCOPE_PREFIXES)
+    if lock_scope:
         findings.extend(check_locks(sf))
+        findings.extend(check_races(sf))
     if _matches(sf.path, STAGING_SCOPE_PREFIXES):
         findings.extend(check_staging(sf))
+    # MUST be last: it audits the waiver-usage record the families above
+    # populate as they consume waivers (races.check_dead_waivers docstring)
+    findings.extend(check_dead_waivers(sf, lock_scope=lock_scope))
     return findings
+
+
+def check_baseline_hygiene(baseline: List[Dict[str, str]]) -> List[str]:
+    """The checked-in baseline must be sorted and duplicate-free — an
+    unsorted file churns diffs, and a duplicated entry silently doubles a
+    suppression budget (split_baselined counts entries)."""
+    errors: List[str] = []
+    keys = [
+        (e["rule"], e["path"], e.get("symbol", ""), e["text"])
+        for e in baseline
+    ]
+    if keys != sorted(keys):
+        errors.append(
+            "baseline is not sorted by (rule, path, symbol, text); "
+            "regenerate with --write-baseline"
+        )
+    seen = set()
+    for k in keys:
+        if k in seen:
+            errors.append(
+                f"baseline entry duplicated: {'/'.join(k[:2])} "
+                f"[{k[0]}] — each finding must appear once"
+            )
+        seen.add(k)
+    return errors
 
 
 def run_lint(
@@ -135,6 +172,7 @@ def run_lint(
         return result
 
     baseline = load_baseline(baseline_path) if baseline_path else []
+    result.errors.extend(check_baseline_hygiene(baseline))
     result.new, result.baselined = split_baselined(pairs, baseline)
     result.new.sort(key=lambda f: (f.path, f.line, f.rule))
     return result
@@ -187,7 +225,16 @@ def main(argv: Optional[List[str]] = None, root: Optional[str] = None) -> int:
                    help="Accept all current findings into the baseline file")
     p.add_argument("--show-baselined", action="store_true",
                    help="Also list suppressed (baselined) findings")
+    p.add_argument("--races", action="store_true",
+                   help="After the static pass, run the dynamic race "
+                        "certification: a seeded sim sweep under lockset/"
+                        "lock-order instrumentation (docs/analysis.md)")
+    p.add_argument("--race-seeds", type=int, default=None, metavar="N",
+                   help="Seed count for --races (default 5; `make race` "
+                        "runs the full 50-seed acceptance sweep)")
     args = p.parse_args(argv)
+    if args.race_seeds is not None:
+        args.races = True
 
     root = root or os.getcwd()
     if not args.paths and not os.path.isdir(os.path.join(root, "babble_tpu")):
@@ -211,7 +258,14 @@ def main(argv: Optional[List[str]] = None, root: Optional[str] = None) -> int:
         )
         return 0
     print(format_report(result, verbose_baselined=args.show_baselined))
-    return 0 if result.ok else 1
+    rc = 0 if result.ok else 1
+    if args.races:
+        from .lockruntime import run_race_certification
+
+        rc = max(rc, run_race_certification(
+            seeds=args.race_seeds if args.race_seeds is not None else 5
+        ))
+    return rc
 
 
 if __name__ == "__main__":

@@ -35,7 +35,7 @@ class InmemTransport(Transport):
         self._consumer: "queue.Queue[RPC]" = queue.Queue()
         self._addr = addr or new_inmem_addr()
         self.timeout = timeout
-        self._peers: Dict[str, "InmemTransport"] = {}
+        self._peers: Dict[str, "InmemTransport"] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
 
     def consumer(self) -> "queue.Queue[RPC]":

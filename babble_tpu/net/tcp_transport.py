@@ -120,7 +120,10 @@ class TCPTransport(Transport):
         self._shutdown = threading.Event()
         # wire metrics: None until the owning node binds its obs bundle
         # (a bare transport — tests, tools — records nothing)
+        # unguarded-ok: rebound once in bind_obs at node boot, before any
+        # peer traffic; racing readers see None and simply skip recording
         self._m_frame_bytes = None
+        # unguarded-ok: same boot-time bind_obs rebind as _m_frame_bytes
         self._m_rpcs = None
         self._accept_thread = threading.Thread(
             target=self._listen, name=f"tcp-accept-{self._addr}", daemon=True
@@ -134,6 +137,7 @@ class TCPTransport(Transport):
         refs are cached so the frame hot path pays one attribute load."""
         from ..obs import DEFAULT_SIZE_BUCKETS
 
+        # unguarded-ok: bound once at node boot, before any peer traffic
         self.obs = obs
         self._m_frame_bytes = obs.histogram(
             "babble_net_frame_bytes",

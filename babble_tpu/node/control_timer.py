@@ -27,12 +27,15 @@ class ControlTimer:
         self.timer_factory = timer_factory
         self.clock = clock or SYSTEM_CLOCK
         self.tick_ch: "queue.Queue[None]" = queue.Queue(maxsize=1)
+        # unguarded-ok: advisory armed flag with a single writer (the
+        # timer thread); the node's reads tolerate one tick of staleness
         self.set = False
         self._cv = threading.Condition()
         self._deadline: Optional[float] = None
-        self._reset = False
-        self._stop = False
-        self._shutdown = False
+        self._reset = False  # guarded-by: _cv
+        self._stop = False  # guarded-by: _cv
+        self._shutdown = False  # guarded-by: _cv
+        # unguarded-ok: bound once in run() at boot; shutdown() joins it
         self._thread: Optional[threading.Thread] = None
 
     def run(self) -> None:

@@ -134,21 +134,32 @@ class Node(NodeStateMachine):
             conf.heartbeat_timeout, rng=conf.rng, clock=conf.clock
         )
 
+        # unguarded-ok: single-writer babble-loop bookkeeping; the stats
+        # endpoint reads are advisory and staleness-tolerant
         self.start_time = self.clock.monotonic()
+        # unguarded-ok: single-writer babble-loop counter, advisory reads
         self.sync_requests = 0
+        # unguarded-ok: single-writer babble-loop counter, advisory reads
         self.sync_errors = 0
         # CatchingUp->Babbling bounces from the fast-forward rewind guards:
         # self-resolving in ordinary operation, but a node stuck ping-ponging
         # (crashed before gossiping its newest own events while genuinely
         # behind) must be operationally visible (ADVICE r3)
+        # unguarded-ok: written only by the babble/catch-up loop (single
+        # writer); the stats endpoint reads tolerate staleness
         self.fast_forward_bounces = 0
+        # unguarded-ok: same single-writer loop state as above
         self._consecutive_bounces = 0
+        # unguarded-ok: same single-writer loop state as above
         self._missing_parent_syncs = 0
+        # unguarded-ok: same single-writer loop state as above
         self._missing_parent_threshold = 3
         # set when flipping to CatchingUp because our OWN store lost event
         # bodies (the eviction livelock): licenses fast_forward to accept
         # an own-chain rewind — IF every peer's reported high-water for
         # our chain confirms the tail never reached them (_peer_acks)
+        # unguarded-ok: flipped only by the babble/catch-up loop (single
+        # writer); consumed by the same loop's fast_forward
         self._rewind_ok = False
         # highest own-chain seq that has ever left this node through a
         # SUCCESSFUL export (our eager push, a served sync diff, or a
@@ -167,10 +178,12 @@ class Node(NodeStateMachine):
         # get_snapshot fails ("snapshot N not found") and starves joiners.
         # Single writer (the commit loop); racing readers only ever see a
         # slightly stale floor, which is safe (they serve an older anchor).
+        # unguarded-ok: the single-writer/stale-floor argument above
         self._app_committed_index = -1
 
         # single-writer (the _babble loop) in-flight outbound exchange
         # count; GIL-atomic decrement from the finishing gossip thread
+        # unguarded-ok: the single-writer/GIL-atomic argument above
         self._gossip_inflight = 0
 
         # -- metric declarations (static names: the obs-* lint family
@@ -354,12 +367,14 @@ class Node(NodeStateMachine):
             )
 
         # rate limit for log_stats (satellite: no full dict per heartbeat)
+        # unguarded-ok: single-writer babble-loop timestamp
         self._last_stats_log = float("-inf")
 
         self.need_bootstrap = store.need_bootstrap()
         self.set_starting(True)
         self.set_state(NodeState.BABBLING)
 
+        # unguarded-ok: bound once in run_async at boot; shutdown joins it
         self._run_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------

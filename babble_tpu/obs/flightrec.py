@@ -216,6 +216,7 @@ class FlightRecorder:
             self._last_dump_at = t
             self.dumps += 1
             ordinal = self.dumps
+            dropped = self.dropped
         records = [r.to_dict() for r in self.records()]
         doc = {
             "reason": reason,
@@ -226,7 +227,7 @@ class FlightRecorder:
                 k: (round(v, 9) if isinstance(v, float) else v)
                 for k, v in context.items()
             },
-            "dropped": self.dropped,
+            "dropped": dropped,
             "records": records,
         }
         with self._lock:

@@ -105,7 +105,7 @@ class Metric:
         self.help = help_text
         self.label_names: Tuple[str, ...] = tuple(label_names)
         self._lock = threading.Lock()
-        self._series: Dict[Tuple[str, ...], object] = {}
+        self._series: Dict[Tuple[str, ...], object] = {}  # guarded-by: _lock
 
     # -- label resolution --------------------------------------------------
 
@@ -115,15 +115,19 @@ class Metric:
                 f"{self.name}: got labels {sorted(kv)}, "
                 f"declared {list(self.label_names)}"
             )
-        key = tuple(str(kv[ln]) for ln in self.label_names)
-        return _Child(self, self._bound_key(key))
+        # the child carries the RAW key; the cardinality bound is applied
+        # inside each mutation op while self._lock is held, so admission
+        # and insertion are one atomic step (deciding here and inserting
+        # later let two first-callers overshoot MAX_LABEL_SETS)
+        return _Child(self, tuple(str(kv[ln]) for ln in self.label_names))
 
-    def _bound_key(self, key: Tuple[str, ...]) -> Tuple[str, ...]:
+    def _bind_locked(self, key: Tuple[str, ...]) -> Tuple[str, ...]:  # requires-lock: _lock
         """The declared-bounded cardinality guarantee: novel combinations
-        past MAX_LABEL_SETS collapse into one `other` series."""
-        with self._lock:
-            if key in self._series or len(self._series) < MAX_LABEL_SETS:
-                return key
+        past MAX_LABEL_SETS collapse into one `other` series. Must be
+        called with self._lock held, immediately before the insertion it
+        admits."""
+        if key in self._series or len(self._series) < MAX_LABEL_SETS:
+            return key
         return (OVERFLOW_LABEL,) * len(key)
 
     def _no_labels_key(self) -> Tuple[str, ...]:
@@ -157,6 +161,7 @@ class Counter(Metric):
         if amount < 0:
             raise ValueError(f"{self.name}: counters only go up")
         with self._lock:
+            key = self._bind_locked(key)
             self._series[key] = self._series.get(key, 0.0) + amount
 
     def value(self, **kv: str) -> float:
@@ -185,6 +190,8 @@ class Gauge(Metric):
     def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
         super().__init__(name, help_text, label_names)
         # pull-time callback for the unlabeled series (read at render)
+        # unguarded-ok: rebound once at declaration time; racing readers
+        # see None or the callback, both safe
         self._fn: Optional[Callable[[], float]] = None
 
     def set(self, value: float) -> None:
@@ -204,10 +211,11 @@ class Gauge(Metric):
 
     def _set(self, key: Tuple[str, ...], value: float) -> None:
         with self._lock:
-            self._series[key] = float(value)
+            self._series[self._bind_locked(key)] = float(value)
 
     def _inc(self, key: Tuple[str, ...], amount: float) -> None:
         with self._lock:
+            key = self._bind_locked(key)
             self._series[key] = self._series.get(key, 0.0) + amount
 
     def value(self, **kv: str) -> float:
@@ -264,6 +272,7 @@ class Histogram(Metric):
     def _observe(self, key: Tuple[str, ...], value: float) -> None:
         v = float(value)
         with self._lock:
+            key = self._bind_locked(key)
             st = self._series.get(key)
             if st is None:
                 # per-bucket counts (non-cumulative) + [sum, count]
@@ -332,7 +341,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: Dict[str, Metric] = {}
+        self._metrics: Dict[str, Metric] = {}  # guarded-by: _lock
 
     def counter(self, name: str, help_text: str = "",
                 labels: Sequence[str] = ()) -> Counter:
@@ -351,19 +360,27 @@ class MetricsRegistry:
         )
 
     def _get_or_create(self, cls, name, help_text, labels, **kw) -> Metric:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is not None:
-                if type(m) is not cls or m.label_names != tuple(labels):
-                    raise ValueError(
-                        f"metric {name!r} re-declared as {cls.kind} "
-                        f"labels={tuple(labels)} (was {m.kind} "
-                        f"labels={m.label_names})"
-                    )
-                return m
-            m = cls(name, help_text, labels, **kw)
-            self._metrics[name] = m
-            return m
+        # double-checked creation: a lock-free fast path for the hot
+        # re-request case (a GIL-atomic dict read; never partially
+        # constructed, since insertion below happens after construction,
+        # under the lock), then re-check + create under the registry lock
+        # so N concurrent first-callers all receive the SAME instance.
+        # unguarded-ok: fast-path read; the locked slow path re-validates
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name, help_text, labels, **kw)
+                    self._metrics[name] = m
+                    return m
+        if type(m) is not cls or m.label_names != tuple(labels):
+            raise ValueError(
+                f"metric {name!r} re-declared as {cls.kind} "
+                f"labels={tuple(labels)} (was {m.kind} "
+                f"labels={m.label_names})"
+            )
+        return m
 
     def get(self, name: str) -> Optional[Metric]:
         with self._lock:

@@ -113,6 +113,8 @@ class SLOEngine:
         self.logger = logger if logger is not None else logging.getLogger(
             "babble.slo"
         )
+        # unguarded-ok: objectives are declared during single-threaded
+        # boot and the dict is read-only once the tick loop starts
         self._objectives: Dict[str, SLObjective] = {}
         # serializes evaluate() between the tick loop and /debug/slo
         self._lock = threading.Lock()
@@ -120,7 +122,7 @@ class SLOEngine:
         # longest window
         self._samples: Deque[Tuple[float, Dict[str, dict]]] = deque()
         self._t0 = self.clock.monotonic()
-        self._breached: Dict[str, bool] = {}
+        self._breached: Dict[str, bool] = {}  # guarded-by: _lock
         self._g_burn = obs.gauge(
             "babble_slo_burn_rate",
             "Error-budget burn rate per objective and window (>= 1 in "
@@ -156,6 +158,7 @@ class SLOEngine:
                           budget=budget, labels=labels,
                           description=description)
         self._objectives[name] = obj
+        # unguarded-ok: declaration happens at boot, before the tick loop
         self._breached[name] = False
         self._g_breached.labels(objective=name).set(0.0)
         return obj
@@ -236,7 +239,7 @@ class SLOEngine:
         with self._lock:
             return self._evaluate_locked()
 
-    def _evaluate_locked(self) -> Dict[str, Any]:
+    def _evaluate_locked(self) -> Dict[str, Any]:  # requires-lock: _lock
         now = self.clock.monotonic()
         readings = {n: self._read(o) for n, o in self._objectives.items()}
         self._samples.append((now, readings))
@@ -339,4 +342,5 @@ class SLOEngine:
 
     def breached(self) -> List[str]:
         """Names of currently-breached objectives (bench gates)."""
+        # unguarded-ok: racy boolean snapshot; bench gates tolerate staleness
         return [n for n, b in self._breached.items() if b]

@@ -43,7 +43,7 @@ class SpanTracer:
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._ring: List[Optional[Span]] = [None] * capacity
+        self._ring: List[Optional[Span]] = [None] * capacity  # guarded-by: _lock
         self._next = 0  # guarded-by: _lock — total spans ever recorded
         self.dropped = 0  # guarded-by: _lock — overwritten by ring wrap
 

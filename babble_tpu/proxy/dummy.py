@@ -21,9 +21,9 @@ from .proxy import ProxyHandler
 class State(ProxyHandler):
     def __init__(self, logger: logging.Logger = None):
         self.logger = logger or logging.getLogger("dummy")
-        self.committed_txs: List[bytes] = []
-        self.state_hash: bytes = b""
-        self.snapshots: Dict[int, bytes] = {}
+        self.committed_txs: List[bytes] = []  # guarded-by: _lock
+        self.state_hash: bytes = b""  # guarded-by: _lock
+        self.snapshots: Dict[int, bytes] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def commit_handler(self, block: Block) -> bytes:

@@ -183,6 +183,8 @@ class JSONRPCServer:
         # reconnects on its next call
         self.idle_timeout = idle_timeout
         self._conn_slots = threading.BoundedSemaphore(max_inbound)
+        # unguarded-ok: populated by register() before start() spawns the
+        # accept loop; read-only once serving
         self._handlers: Dict[str, Callable[[Any], Any]] = {}
         self._shutdown = threading.Event()
         self._thread = threading.Thread(

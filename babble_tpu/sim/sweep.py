@@ -19,6 +19,18 @@ from .cluster import SimCluster
 from .faults import FaultPlan, preset_plan
 
 
+def _race_certifier():
+    """The innermost active certify() scope, or None. Imported lazily so
+    a plain (uninstrumented) sweep never pulls in the analysis package;
+    already-active certification means the module is loaded anyway."""
+    import sys
+
+    lr = sys.modules.get("babble_tpu.analysis.lockruntime")
+    if lr is None:
+        return None
+    return lr.active_certifier()
+
+
 def run_one(
     seed: int,
     plan: Union[str, FaultPlan] = "clean",
@@ -46,6 +58,10 @@ def run_one(
     if store == "sqlite" and store_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix=f"babble-sim-{seed}-")
         store_dir = tmp.name
+    # race certification (analysis/lockruntime.py): when this run happens
+    # inside a certify() scope, feed race findings into the nodes' flight
+    # recorders and fail the seed on new findings, exactly like divergence
+    cert = _race_certifier()
     cluster = SimCluster(
         n=n,
         seed=seed,
@@ -63,6 +79,12 @@ def run_one(
         tracing=tracing,
         stall_deadline=stall_deadline,
     )
+    cert_before = 0
+    if cert is not None:
+        cert_before = len(cert.findings)
+        for sn in cluster.sns:
+            cert.attach_recorder(sn.node.obs.flightrec)
+    res = None
     try:
         res = cluster.run(until=until, target_block=target_block)
         res["ok"] = True
@@ -79,6 +101,27 @@ def run_one(
         # dumps that preceded it), exported beside the replay artifact
         res["flightrec"] = cluster.export_flight_dumps(artifact_dir)
     finally:
+        if cert is not None:
+            # cycles surface per-seed, not only at certify() exit, so a
+            # failing seed is identifiable and exports its own dumps
+            cert.check_lock_order()
+            new = cert.findings[cert_before:]
+            if res is not None:
+                res["race_findings"] = [dict(f) for f in new]
+                if new and res["ok"]:
+                    from ..analysis.lockruntime import format_finding
+
+                    res["ok"] = False
+                    res["error"] = "race certification: " + "; ".join(
+                        format_finding(f) for f in new
+                    )
+                    cluster.dump_flight_recorders("race-candidate")
+                    res["flightrec"] = cluster.export_flight_dumps(
+                        artifact_dir
+                    )
+            for sn in cluster.sns:
+                if sn.node is not None:
+                    cert.detach_recorder(sn.node.obs.flightrec)
         cluster.shutdown()
         if tmp is not None:
             tmp.cleanup()

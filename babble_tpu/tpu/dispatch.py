@@ -63,7 +63,10 @@ class _AsyncPass:
 
     def __init__(self, mesh, grid, prefer_doubling: bool = False):
         self.done = threading.Event()
+        # unguarded-ok: Event handoff — _run's writes happen-before
+        # done.set(), and result() reads only after done.wait()
         self.value = None
+        # unguarded-ok: same Event handoff as value
         self.error: Optional[BaseException] = None
         threading.Thread(
             target=self._run, args=(mesh, grid, prefer_doubling),

@@ -831,7 +831,10 @@ class _AsyncFetch:
         import threading
 
         self.done = threading.Event()
+        # unguarded-ok: Event handoff — _run's writes happen-before
+        # done.set(), and result() reads only after done.wait()
         self.value = None
+        # unguarded-ok: same Event handoff as value
         self.error: Optional[BaseException] = None
         threading.Thread(
             target=self._run, args=(device_array,), name="live-fetch",

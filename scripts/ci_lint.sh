@@ -19,6 +19,13 @@ rc=0
 echo "== babble-tpu lint (hard gate) =="
 python -m babble_tpu lint || rc=1
 
+# Dynamic concurrency certification (hard gate, ISSUE 12): a seeded sim
+# sweep under lockset/lock-order instrumentation. Seeds are env-tunable:
+# the full `make race` acceptance sweep runs 50; CI defaults to a small
+# smoke so the gate stays fast (the detectors are deterministic per seed).
+echo "== babble-tpu race certification (hard gate) =="
+python -m babble_tpu lint --races --race-seeds "${BABBLE_RACE_SEEDS:-5}" || rc=1
+
 echo "== ruff (advisory) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"

@@ -24,3 +24,25 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _race_certify_session():
+    """`make race` / BABBLE_RACE_CERTIFY=1: run the entire tier-1 suite
+    inside one certify() scope, and fail the session if any race
+    candidate or lock-order cycle surfaced (analysis/lockruntime.py).
+    Off by default: instrumentation patches live classes, and tests that
+    construct seeded defects manage their own nested scopes."""
+    if not os.environ.get("BABBLE_RACE_CERTIFY"):
+        yield None
+        return
+    from babble_tpu.analysis.lockruntime import certify, format_finding
+
+    with certify() as cert:
+        yield cert
+    assert not cert.findings, (
+        "race certification failed across the test session: "
+        + "; ".join(format_finding(f) for f in cert.findings)
+    )

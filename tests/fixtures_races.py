@@ -1,0 +1,59 @@
+"""Seeded concurrency defects for the race-certification tests (ISSUE 12).
+
+These classes are DELIBERATELY wrong. They live under tests/ — outside
+the lint scope — so the real tree stays clean, and exist to prove the
+detectors actually fire:
+
+- `UnguardedBox`  — an annotated field written without its lock (the
+  dynamic lockset detector must report `race.candidate`) plus an
+  unannotated shared field (the static inference pass must report
+  `lock-unannotated`).
+- `InvertedPair`  — an A→B / B→A lock-order inversion (the lock-order
+  analyzer must report `lockorder.cycle`).
+
+Do not fix them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class UnguardedBox:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: _lock
+        # seeded defect: shared, mutated below, no annotation, no waiver
+        self._tally = 0
+
+    def locked_bump(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def unguarded_bump(self) -> None:
+        # seeded defect: guarded field written without holding the lock
+        self._count += 1
+
+    def tally_bump(self) -> None:
+        self._tally += 1
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self._count
+
+
+class InvertedPair:
+    def __init__(self) -> None:
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self) -> None:
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self) -> None:
+        # seeded defect: inverted acquisition order vs ab()
+        with self._b:
+            with self._a:
+                pass
