@@ -38,14 +38,21 @@ Rules (waiver tag `obs-ok`):
   `babble_slo_*` gauge label values must be statically enumerable, and
   the series must be a reviewable literal so the referenced metric can
   be checked against the catalog.
+- obs-prov-static-name — a provenance stream marker (`*.mark(...)` on a
+  provenance/prov receiver) whose name is not a string literal.  Mark
+  names feed the record catalog (docs/observability.md) and the
+  provenance stream's determinism fingerprint, which joins the sim's
+  byte-identical-replay contract (docs/sim.md) — the same reasoning as
+  flight-recorder record names.
 
 Scope: any call `<recv>.counter|gauge|histogram(...)` where the receiver
 chain ends in `obs`, `registry`, `reg` or `metrics` — the conventional
 handles for the per-node Observability bundle and its MetricsRegistry —
 any call `<recv>.span|record(...)` where it ends in `obs` or `tracer`,
 any call `<recv>.record(...)` where it ends in `flightrec` or
-`recorder`, and any call `<recv>.objective(...)` where it ends in
-`slo`.
+`recorder`, any call `<recv>.objective(...)` where it ends in `slo`,
+and any call `<recv>.mark(...)` where it ends in `provenance` or
+`prov`.
 """
 
 from __future__ import annotations
@@ -68,6 +75,9 @@ FLIGHT_RECEIVER_TAILS = {"flightrec", "recorder"}
 
 SLO_METHODS = {"objective"}
 SLO_RECEIVER_TAILS = {"slo"}
+
+PROV_METHODS = {"mark"}
+PROV_RECEIVER_TAILS = {"provenance", "prov"}
 
 # Vocabulary that must never appear in hashgraph/event.py (signed-body
 # construction): identifiers or short key-like strings naming the causal
@@ -122,6 +132,16 @@ def _flight_receiver(func: ast.Attribute) -> Optional[str]:
     return recv if tail in FLIGHT_RECEIVER_TAILS else None
 
 
+def _prov_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a provenance mark, or None when this is not
+    a recorder call we police (e.g. `parser.mark(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in PROV_RECEIVER_TAILS else None
+
+
 def _slo_receiver(func: ast.Attribute) -> Optional[str]:
     """The receiver chain of an SLO declaration, or None when this is
     not an engine call we police."""
@@ -165,7 +185,27 @@ class _ObsVisitor(SymbolTracker):
             recv = _slo_receiver(func)
             if recv is not None:
                 self._check_slo(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in PROV_METHODS:
+            recv = _prov_receiver(func)
+            if recv is not None:
+                self._check_prov(node, recv, func.attr)
         self.generic_visit(node)
+
+    def _check_prov(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-prov-static-name", node,
+                f"{recv}.{method}(...) emits a provenance stream mark with "
+                "a computed name; mark names must be static string "
+                "literals — they feed the record catalog "
+                "(docs/observability.md) and the provenance stream's "
+                "determinism fingerprint (docs/sim.md), so a "
+                "runtime-computed name breaks both",
+            )
 
     def _check_flight(self, node: ast.Call, recv: str, method: str) -> None:
         name_arg: Optional[ast.AST] = node.args[0] if node.args else None

@@ -147,6 +147,31 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--log", default="error", choices=sorted(LOG_LEVELS),
                      help="Log level for the simulated nodes")
 
+    ex = sub.add_parser(
+        "explain",
+        help="Decision provenance: explain one round (live node or "
+             "offline bisect; docs/observability.md)",
+    )
+    ex.add_argument("--addr", default="127.0.0.1:8000",
+                    help="HTTP service address of a running node "
+                         "(GET /debug/explain)")
+    ex.add_argument("--block", type=int, default=None,
+                    help="Explain the round that received this block")
+    ex.add_argument("--round", type=int, default=None,
+                    help="Explain this consensus round directly")
+    ex.add_argument("--bisect", nargs=2, metavar=("A.json", "B.json"),
+                    default=None,
+                    help="Offline: diff two exported provenance streams "
+                         "(sim export_provenance files) and print the "
+                         "earliest divergent cell")
+    ex.add_argument("--artifact-dir", default="",
+                    help="With --bisect: also export the localization "
+                         "triage artifact into this directory")
+    ex.add_argument("--smoke", type=int, default=0, metavar="N",
+                    help="Self-test: run the N-seed bisector smoke "
+                         "(seeded synthetic divergence must localize "
+                         "exactly; clean pairs must localize nothing)")
+
     # `lint` is dispatched before the main parse (main()): the analysis
     # runner owns its own argparse, and argparse.REMAINDER inside a
     # subparser mis-handles leading optionals. Registered here so it
@@ -334,6 +359,15 @@ def sim_command(args: argparse.Namespace) -> int:
                 f"blocks={row['blocks_checked']} t={row['virtual_time']}"
                 f" restarts={row['restarts']} flips={row['catchup_flips']}"
             )
+            if not row["ok"] and row.get("localized"):
+                loc = row["localized"]
+                print(
+                    "  localized: round %s %s/%s cell %s (%s)" % (
+                        loc["round"], loc["pass"], loc["table"],
+                        (loc.get("cell") or "")[:18],
+                        row.get("bisect_artifact"),
+                    )
+                )
             if not row["ok"] and row.get("flightrec"):
                 print(f"  flight-recorder triage: {row['flightrec']}")
 
@@ -353,6 +387,11 @@ def sim_command(args: argparse.Namespace) -> int:
                     "flight-recorder triage: "
                     f"{summary['flightrec_artifacts']}"
                 )
+            if summary.get("bisect_artifacts"):
+                print(
+                    "bisection triage: "
+                    f"{summary['bisect_artifacts']}"
+                )
             return 1
         return 0
 
@@ -360,6 +399,66 @@ def sim_command(args: argparse.Namespace) -> int:
     out = {k: v for k, v in res.items() if k != "rows"}
     print(json.dumps(out, indent=2, sort_keys=True))
     return 0 if res["ok"] else 1
+
+
+def explain_command(args: argparse.Namespace) -> int:
+    """`babble-tpu explain` — three modes, one triage surface:
+
+    - `--smoke N` (CI entry): seeded synthetic bisector self-test; the
+      injected fame flip must localize to its exact cell and a clean
+      pair must localize nothing. Nonzero exit on any failure.
+    - `--bisect A.json B.json`: offline first-divergence bisection of
+      two exported provenance streams (sim `export_provenance` files).
+    - `--addr/--block/--round`: fetch the decision dossier from a live
+      node's GET /debug/explain.
+    """
+    from .obs import DivergenceBisector, run_bisector_smoke
+
+    if args.smoke > 0:
+        failures = run_bisector_smoke(seeds=args.smoke)
+        for f in failures:
+            print(f"FAIL: {f}")
+        print(
+            f"bisector smoke: {args.smoke} seeds, "
+            f"{len(failures)} failures"
+        )
+        return 1 if failures else 0
+
+    if args.bisect is not None:
+        a_path, b_path = args.bisect
+        with open(a_path) as f:
+            a_doc = json.load(f)
+        with open(b_path) as f:
+            b_doc = json.load(f)
+        a_name = os.path.splitext(os.path.basename(a_path))[0]
+        b_name = os.path.splitext(os.path.basename(b_path))[0]
+        bis = DivergenceBisector(args.artifact_dir or "docs/artifacts")
+        loc = bis.bisect(a_name, a_doc, b_name, b_doc)
+        if loc is None:
+            print("streams agree: no divergent cell")
+            return 0
+        print(json.dumps(loc, indent=2, sort_keys=True))
+        if args.artifact_dir:
+            path = bis.export(
+                loc, f"bisect-{a_name}-vs-{b_name}.json",
+                context={"a": a_path, "b": b_path},
+            )
+            print(f"triage artifact: {path}")
+        return 1
+
+    if args.block is None and args.round is None:
+        print("explain needs --block, --round, --bisect or --smoke",
+              file=sys.stderr)
+        return 2
+    url = f"http://{args.addr}/debug/explain?"
+    url += (f"round={args.round}" if args.round is not None
+            else f"block={args.block}")
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        doc = json.loads(resp.read().decode())
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    return 0
 
 
 def keygen_command(args: argparse.Namespace) -> int:
@@ -387,6 +486,8 @@ def main(argv=None) -> int:
         return run_command(args)
     if args.command == "sim":
         return sim_command(args)
+    if args.command == "explain":
+        return explain_command(args)
     if args.command == "keygen":
         return keygen_command(args)
     if args.command == "version":

@@ -599,6 +599,10 @@ class Hashgraph:
 
                 round_info.add_event(hash_, is_witness)
                 self.store.set_round(round_number, round_info)
+                if is_witness:
+                    self.obs.provenance.note_witness(
+                        hash_, round_number, self.peer_position(ev.creator()),
+                    )
 
             if ev.lamport_timestamp is None:
                 ev.set_lamport_timestamp(self.lamport_timestamp(hash_))
@@ -606,6 +610,18 @@ class Hashgraph:
 
             if update_event:
                 self.store.set_event(ev)
+                if (
+                    ev.round is not None
+                    and ev.lamport_timestamp is not None
+                    and ev.last_ancestors is not None
+                ):
+                    # decision provenance: the DivideRounds table cell —
+                    # same value the device engines capture from their
+                    # staged lastAncestors rows (obs/provenance.py)
+                    self.obs.provenance.note_event(
+                        hash_, ev.round, ev.lamport_timestamp,
+                        ev.last_ancestors,
+                    )
 
     def decide_fame(self) -> None:
         """Virtual voting on witness fame (reference:
@@ -621,6 +637,11 @@ class Hashgraph:
                 if round_info.is_decided(x):
                     continue
                 decided = False
+                # decision provenance: coin rounds traversed (and coin
+                # flips taken) while fame of x was open — part of the
+                # "why" on the landed verdict (obs/provenance.py)
+                x_coins = 0
+                x_flips = 0
                 for j in range(round_index + 1, self.store.last_round() + 1):
                     if decided:
                         break
@@ -649,16 +670,27 @@ class Hashgraph:
                                     self.max_fame_depth = max(
                                         self.max_fame_depth, diff
                                     )
+                                    # the landed verdict with its full
+                                    # "why": deciding voter, tallies,
+                                    # strongly-seen count, deciding step
+                                    self.obs.provenance.note_fame(
+                                        x, round_index, v, engine="cpu",
+                                        voter=y, yays=yays, nays=nays,
+                                        ss=len(ss_witnesses), step=diff,
+                                        coins=x_coins, flips=x_flips,
+                                    )
                                     break
                                 votes[(y, x)] = v
                             else:
                                 # coin round
                                 self.coin_rounds += 1
+                                x_coins += 1
                                 if t >= self.super_majority:
                                     votes[(y, x)] = v
                                 else:
                                     votes[(y, x)] = middle_bit(y)
                                     self.coin_flips += 1
+                                    x_flips += 1
 
             self.store.set_round(round_index, round_info)
             if round_info.witnesses_decided():
@@ -708,6 +740,7 @@ class Hashgraph:
                     received = True
                     ex = self.store.get_event(x)
                     ex.set_round_received(i)
+                    self.obs.provenance.note_received(x, i)
                     self.obs.traces.mark_famous(ex.transactions())
                     self.store.set_event(ex)
                     tr.set_consensus_event(x)
@@ -788,6 +821,8 @@ class Hashgraph:
 
                 pos += 1
                 self._set_last_consensus_round(pr.index)
+                # the round's tables are committed history from here on
+                self.obs.provenance.settle_round(pr.index)
         finally:
             self.pending_rounds = pending[pos:]
 

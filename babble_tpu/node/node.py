@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 
 from ..hashgraph import Block, Store, WireEvent
 from ..obs import DEFAULT_COUNT_BUCKETS, Observability, SLOEngine
+from ..obs.tracectx import trace_id_for
 from ..net import (
     EagerSyncRequest,
     EagerSyncResponse,
@@ -1003,13 +1004,19 @@ class Node(NodeStateMachine):
         now = self.clock.monotonic()
         self._m_blocks.inc()
         latencies = []
+        last_traced: Optional[bytes] = None
         with self._tx_times_lock:
             for tx in block.transactions():
                 t0 = self._tx_times.pop(bytes(tx), None)
                 if t0 is not None:
                     latencies.append(now - t0)
+                    last_traced = bytes(tx)
+        # exemplar: the last committed traced tx's trace_id rides on the
+        # latency histogram (and its /metrics comment line), so a p99
+        # breach links straight to a concrete trace in /debug/trace
+        exemplar = trace_id_for(last_traced) if last_traced else None
         for dt in latencies:
-            self._m_commit_latency.observe(dt)
+            self._m_commit_latency.observe(dt, exemplar=exemplar)
         self.obs.tracer.record(
             "commit", now, 0.0,
             {"block": block.index(), "txs": len(block.transactions())},

@@ -73,6 +73,10 @@ class LivenessWatchdog:
         # the flight recorder gets the stall/recover records and the
         # auto-dump; attribute name `flightrec` is the lint convention
         self.flightrec = obs.flightrec
+        # decision provenance: stall triage starts from the frozen
+        # decision — the record carries the stuck round's table
+        # fingerprint so it can be diffed against a healthy peer's
+        self.provenance = obs.provenance
         self._g_stalled = obs.gauge(
             "babble_consensus_stalled",
             "1 while round-received has not advanced within the stall "
@@ -184,14 +188,23 @@ class LivenessWatchdog:
                 waited, self.deadline, last_round,
             )
             self._m_stalls.inc()
+            # the stuck round is the first one past the last decided:
+            # its provenance fingerprint (None -> "" when the round has
+            # no cells yet) names the frozen decision tables, so triage
+            # starts from the decision, not the whole ring
+            stuck = (last_round + 1) if last_round is not None else 0
+            prov_fp = self.provenance.round_fingerprint(stuck) or ""
             self.flightrec.record(
                 "watchdog.stall", waited=waited, deadline=self.deadline,
-                round=last_round,
+                round=last_round, last_decided_round=last_round,
+                stuck_round=stuck, prov=prov_fp,
             )
             # the black box exists for exactly this moment: dump the
             # ring (ladder/dispatch history preceding the stall) now
             self.flightrec.dump("consensus-stall", waited=waited,
-                                round=last_round)
+                                round=last_round,
+                                last_decided_round=last_round,
+                                stuck_round=stuck, prov=prov_fp)
         elif recovered:
             self.logger.info(
                 "consensus resumed: round advanced to %s", rnd,

@@ -37,6 +37,15 @@ from .metrics import (
     MetricsRegistry,
     log_buckets,
 )
+from .provenance import (
+    DEFAULT_PROV_ROUND_CAP,
+    DivergenceBisector,
+    ProvenanceRecorder,
+    RoundProvenance,
+    bisect_pass_results,
+    capture_pass_results,
+    run_bisector_smoke,
+)
 from .trace import DEFAULT_SPAN_CAPACITY, Span, SpanTracer
 from .slo import SLObjective, SLOEngine
 from .tracectx import (
@@ -54,6 +63,13 @@ __all__ = [
     "FlightRecord",
     "SLOEngine",
     "SLObjective",
+    "ProvenanceRecorder",
+    "RoundProvenance",
+    "DivergenceBisector",
+    "capture_pass_results",
+    "bisect_pass_results",
+    "run_bisector_smoke",
+    "DEFAULT_PROV_ROUND_CAP",
     "DEFAULT_FLIGHT_CAPACITY",
     "MetricsRegistry",
     "Counter",
@@ -94,6 +110,12 @@ class Observability:
         # SLO breach — same Clock seam, same determinism contract
         self.flightrec = FlightRecorder(
             clock=self.clock, node_id=node_id, capacity=flightrec_capacity,
+        )
+        # consensus decision provenance (ISSUE 14): per-round voting
+        # tables + fame-decision whys, captured by every engine at its
+        # host-side integration seam — the DivergenceBisector's input
+        self.provenance = ProvenanceRecorder(
+            clock=self.clock, node_id=node_id,
         )
         # cross-node causal tracing (ISSUE 5): live TraceContexts for
         # in-flight transactions, bounded, feeding per-stage histograms

@@ -86,8 +86,8 @@ class _Child:
     def set(self, value: float) -> None:
         self._metric._set(self._key, value)
 
-    def observe(self, value: float) -> None:
-        self._metric._observe(self._key, value)
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        self._metric._observe(self._key, value, exemplar=exemplar)
 
 
 class Metric:
@@ -265,11 +265,17 @@ class Histogram(Metric):
         if list(bs) != sorted(bs) or len(set(bs)) != len(bs):
             raise ValueError(f"{self.name}: buckets must strictly increase")
         self.buckets = bs
+        # last exemplar per series (e.g. the trace_id of the latest
+        # commit-latency observation): rendered as a `# EXEMPLAR` comment
+        # in the exposition so a p99 breach links to a concrete trace
+        self._exemplars: Dict[Tuple[str, ...], str] = {}  # guarded-by: _lock
 
-    def observe(self, value: float) -> None:
-        self._observe(self._no_labels_key(), value)
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
+        self._observe(self._no_labels_key(), value, exemplar=exemplar)
 
-    def _observe(self, key: Tuple[str, ...], value: float) -> None:
+    def _observe(self, key: Tuple[str, ...], value: float,
+                 exemplar: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:
             key = self._bind_locked(key)
@@ -285,6 +291,14 @@ class Histogram(Metric):
             counts[i] += 1
             agg[0] += v
             agg[1] += 1
+            if exemplar is not None:
+                self._exemplars[key] = str(exemplar)
+
+    def exemplar(self, **kv: str) -> Optional[str]:
+        """Last exemplar attached to one series, or None."""
+        key = tuple(str(kv[ln]) for ln in self.label_names) if kv else ()
+        with self._lock:
+            return self._exemplars.get(key)
 
     def stats(self, **kv: str) -> Tuple[int, float]:
         """(count, sum) of one series; (0, 0.0) when never observed."""
@@ -297,6 +311,8 @@ class Histogram(Metric):
 
     def render(self) -> List[str]:
         out: List[str] = []
+        with self._lock:
+            exemplars = dict(self._exemplars)
         for key, st in self._sorted_series():
             counts, agg = st  # type: ignore[misc]
             cum = 0
@@ -309,6 +325,14 @@ class Histogram(Metric):
             ls = self._label_str(key)
             out.append(f"{self.name}_sum{ls} {_fmt(agg[0])}")
             out.append(f"{self.name}_count{ls} {cum}")
+            ex = exemplars.get(key)
+            if ex is not None:
+                # text format 0.0.4 has no native exemplar syntax; a
+                # comment line keeps the exposition parseable everywhere
+                # while still surfacing the trace link next to its series
+                out.append(
+                    f'# EXEMPLAR {self.name}{ls} trace_id="{_escape_label(ex)}"'
+                )
         return out
 
     def _bucket_label(self, key: Tuple[str, ...], le: str) -> str:
@@ -320,15 +344,23 @@ class Histogram(Metric):
 
     def snapshot(self) -> dict:
         series = {}
+        with self._lock:
+            exemplars = dict(self._exemplars)
         for key, st in self._sorted_series():
             counts, agg = st  # type: ignore[misc]
             cum, buckets = 0, []
             for le, c in zip(self.buckets, counts):
                 cum += c
                 buckets.append([_fmt(le), cum])
-            series[",".join(key)] = {
+            entry = {
                 "count": agg[1], "sum": agg[0], "buckets": buckets,
             }
+            ex = exemplars.get(key)
+            if ex is not None:
+                # deterministic under the sim (trace ids hash tx bytes),
+                # so including it keeps the snapshot fingerprint-safe
+                entry["exemplar"] = ex
+            series[",".join(key)] = entry
         return {"type": self.kind, "series": series}
 
 

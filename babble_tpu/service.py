@@ -17,6 +17,10 @@ riding the service mux (reference: cmd/babble/main.go:4):
   ring as JSON (obs/flightrec.py)
 - GET /debug/slo             — SLO objectives with per-window burn rates
   (obs/slo.py; a fresh evaluation per request)
+- GET /debug/explain?block=N — decision-provenance dossier for the round
+  that received block N (or `?round=R` directly): deciding voter, vote
+  tallies, strongly-seen counts, coin rounds, table fingerprint
+  (obs/provenance.py)
 
 and the Prometheus exposition of the node's typed metrics registry:
 
@@ -191,6 +195,31 @@ class Service:
         merged["failed_peers"] = failed
         return merged
 
+    def explain(
+        self, block: Optional[int] = None, round: Optional[int] = None,
+    ) -> dict:
+        """Decision-provenance dossier for one consensus round — the
+        `/debug/explain` payload. `block=N` resolves the block's
+        round_received first; `round=R` asks for the round directly. The
+        dossier (obs/provenance.py `explain_round`) names, per witness,
+        the deciding voter, vote tallies, strongly-seen counts, deciding
+        pass/step and any coin rounds, plus the round's canonical table
+        fingerprint — enough to answer "why did block N land this way"
+        without replaying the run."""
+        obs = getattr(self.node, "obs", None)
+        prov = getattr(obs, "provenance", None)
+        if prov is None:
+            raise ValueError("node has no provenance recorder")
+        doc: dict = {"block_index": None}
+        if round is None:
+            if block is None:
+                raise ValueError("explain needs ?block=N or ?round=R")
+            blk = self.node.get_block(int(block))
+            round = blk.round_received()
+            doc["block_index"] = blk.index()
+        doc.update(prov.explain_round(int(round)))
+        return doc
+
     def debug_allowed(self, client_ip: str) -> bool:
         return self.remote_debug or client_ip in (
             "127.0.0.1", "::1", "::ffff:127.0.0.1",
@@ -262,6 +291,14 @@ class Service:
                                 )
                                 return
                             body = json.dumps(flightrec.to_json()).encode()
+                        elif self.path.startswith("/debug/explain"):
+                            q = parse_qs(urlparse(self.path).query)
+                            blk = q.get("block", [None])[0]
+                            rnd = q.get("round", [None])[0]
+                            body = json.dumps(service.explain(
+                                block=int(blk) if blk is not None else None,
+                                round=int(rnd) if rnd is not None else None,
+                            )).encode()
                         elif self.path == "/debug/slo":
                             slo = getattr(service.node, "slo", None)
                             if slo is None:

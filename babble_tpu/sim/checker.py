@@ -23,13 +23,21 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs import DivergenceBisector
 from ..utils.codec import b64e
 
 
 class DivergenceError(Exception):
-    def __init__(self, message: str, artifact_path: Optional[str] = None):
+    def __init__(self, message: str, artifact_path: Optional[str] = None,
+                 localized: Optional[Dict[str, Any]] = None,
+                 bisect_path: Optional[str] = None):
         super().__init__(message)
         self.artifact_path = artifact_path
+        # first-divergence bisection (obs/provenance.py): the earliest
+        # divergent (pass, table, round, witness) cell, when the cluster
+        # supplied provenance streams to bisect
+        self.localized = localized
+        self.bisect_path = bisect_path
 
 
 class DivergenceChecker:
@@ -76,11 +84,25 @@ class DivergenceChecker:
                 if ref_bytes is None:
                     ref_bytes, ref_name = body, name
                 elif body != ref_bytes:
-                    path = self._dump_artifact(i, holders, views, context)
+                    loc, bisect_path = self._bisect(
+                        i, ref_name, name, context
+                    )
+                    path = self._dump_artifact(
+                        i, holders, views, context, localized=loc
+                    )
+                    msg = "block %d diverges: %s != %s (artifact: %s)" % (
+                        i, name, ref_name, path,
+                    )
+                    if loc is not None:
+                        msg += (
+                            "; localized to round %s %s/%s cell %s" % (
+                                loc["round"], loc["pass"], loc["table"],
+                                (loc.get("cell") or "")[:18],
+                            )
+                        )
                     raise DivergenceError(
-                        "block %d diverges: %s != %s (artifact: %s)"
-                        % (i, name, ref_name, path),
-                        artifact_path=path,
+                        msg, artifact_path=path, localized=loc,
+                        bisect_path=bisect_path,
                     )
             if not settled:
                 break
@@ -102,6 +124,41 @@ class DivergenceChecker:
         except Exception:
             return None
 
+    # -- bisection ------------------------------------------------------
+
+    def _bisect(
+        self,
+        index: int,
+        a_name: str,
+        b_name: str,
+        context: Optional[Dict[str, Any]],
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+        """Diff the two divergent holders' decision-provenance streams
+        (supplied lazily by the cluster as context['provenance_fn']) and
+        export the triage artifact naming the earliest divergent cell.
+        Deterministic filename: seed + block index, like the replay
+        artifact it sits beside."""
+        fn = (context or {}).get("provenance_fn")
+        if fn is None:
+            return None, None
+        try:
+            streams = fn()
+        except Exception:  # noqa: BLE001 — triage must not mask the trip
+            return None, None
+        a_doc, b_doc = streams.get(a_name), streams.get(b_name)
+        if a_doc is None or b_doc is None:
+            return None, None
+        bis = DivergenceBisector(self.artifact_dir)
+        loc = bis.bisect(a_name, a_doc, b_name, b_doc)
+        if loc is None:
+            return None, None
+        seed = (context or {}).get("seed", "unseeded")
+        path = bis.export(
+            loc, f"bisect-seed{seed}-block{index}.json",
+            context={"seed": seed, "block_index": index},
+        )
+        return loc, path
+
     # -- artifact -------------------------------------------------------
 
     def _dump_artifact(
@@ -110,12 +167,15 @@ class DivergenceChecker:
         holders: List[Tuple[str, Any]],
         views: List[Tuple[str, Any]],
         context: Optional[Dict[str, Any]],
+        localized: Optional[Dict[str, Any]] = None,
     ) -> str:
         context = dict(context or {})
+        context.pop("provenance_fn", None)
         trace = context.pop("trace", [])
         artifact = {
             "kind": "babble-tpu-sim-divergence",
             "block_index": index,
+            "localized": localized,
             **context,
             "blocks": {
                 name: {

@@ -478,16 +478,39 @@ class SimCluster:
             "virtual_time": self.clock.now,
             "events_run": self.sched.events_run,
             "trace": self.trace,
+            # lazy: the checker only materializes the decision-provenance
+            # streams on an actual mismatch (bisection input)
+            "provenance_fn": self.provenance_streams,
+        }
+
+    def provenance_streams(self) -> Dict[str, Dict[str, Any]]:
+        """Every live node's full decision-provenance stream document
+        (bisection input; sweep failure export)."""
+        return {
+            sn.name: sn.node.obs.provenance.to_json()
+            for sn in self.sns
+            if not sn.crashed
         }
 
     def check_divergence(self) -> int:
         """Raises DivergenceError (artifact dumped) on any mismatch —
         and dumps every live node's flight recorder beside it, so the
         replay artifact comes with the "what was each node doing"
-        record stream."""
+        record stream. When the checker's bisector localized the first
+        divergent provenance cell, every live node gets the
+        deterministic `divergence.localized` record before the dump."""
         try:
             return self.checker.check(self.live_views(), self._context())
-        except DivergenceError:
+        except DivergenceError as e:
+            if e.localized is not None:
+                from ..obs import DivergenceBisector
+
+                fields = DivergenceBisector().flight_fields(e.localized)
+                for sn in self.sns:
+                    if not sn.crashed:
+                        sn.node.obs.flightrec.record(
+                            "divergence.localized", **fields,
+                        )
             self.dump_flight_recorders("divergence")
             raise
 
@@ -612,6 +635,7 @@ class SimCluster:
             "mesh_dispatch": self.dispatch_histograms(),
             "trace_fingerprint": self.trace_fingerprint(),
             "flightrec_fingerprint": self.flightrec_fingerprint(),
+            "provenance_fingerprint": self.provenance_fingerprint(),
             "flightrec_records": {
                 sn.name: len(sn.node.obs.flightrec)
                 for sn in self.sns
@@ -714,6 +738,38 @@ class SimCluster:
             h.update(sn.name.encode())
             h.update(sn.node.obs.flightrec.stream_bytes())
         return h.hexdigest()
+
+    def provenance_fingerprint(self) -> str:
+        """SHA-256 over every live node's canonical decision-provenance
+        stream bytes, in node order — the provenance entry in the
+        determinism fingerprint: two runs of the same seed+plan must
+        produce byte-identical streams (docs/sim.md)."""
+        h = sha256()
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            h.update(sn.name.encode())
+            h.update(sn.node.obs.provenance.stream_bytes())
+        return h.hexdigest()
+
+    def export_provenance(self, directory: str) -> List[str]:
+        """Write every live node's provenance stream as a JSON artifact
+        (sweep failure export — `babble-tpu explain --bisect` replays
+        the bisection offline from these). Deterministic filenames:
+        seed + node name."""
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            path = os.path.join(
+                directory, f"provenance-seed{self.seed}-{sn.name}.json"
+            )
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(sn.node.obs.provenance.to_json(), f,
+                          indent=1, sort_keys=True)
+            paths.append(path)
+        return paths
 
     def digest(self) -> str:
         """SHA-256 over every settled block body on every live node, in

@@ -91,11 +91,20 @@ def run_one(
         res["error"] = None
         res["artifact"] = None
         res["flightrec"] = []
+        res["localized"] = None
+        res["bisect_artifact"] = None
     except DivergenceError as e:
         res = cluster.result()
         res["ok"] = False
         res["error"] = str(e)
         res["artifact"] = e.artifact_path
+        # first-divergence bisection (obs/provenance.py): the earliest
+        # divergent (pass, table, round, witness) cell plus the per-node
+        # provenance streams it was derived from, exported beside the
+        # replay artifact so the failure is localized, not just detected
+        res["localized"] = e.localized
+        res["bisect_artifact"] = e.bisect_path
+        res["provenance"] = cluster.export_provenance(artifact_dir)
         # triage artifacts: the flight-recorder dumps every node took
         # during the run (the divergence dump plus any stall/flap/SLO
         # dumps that preceded it), exported beside the replay artifact
@@ -169,6 +178,14 @@ def run_sweep(
         "artifacts": [r["artifact"] for r in failures if r["artifact"]],
         "flightrec_artifacts": [
             p for r in failures for p in r.get("flightrec", [])
+        ],
+        # bisection summary: a clean sweep must report ZERO localizations
+        "localizations": [
+            r["localized"] for r in failures if r.get("localized")
+        ],
+        "bisect_artifacts": [
+            r["bisect_artifact"] for r in failures
+            if r.get("bisect_artifact")
         ],
         "total_blocks_checked": sum(r["blocks_checked"] for r in rows),
         "rows": rows,

@@ -332,7 +332,8 @@ class MeshDispatchQueue:
         hg.obs.tracer.record(
             "device.fetch", t0, dt, {"node": hg.obs.node_id},
         )
-        integrate_pass_results(hg, grid, res, topo_hi=topo_hi)
+        integrate_pass_results(hg, grid, res, topo_hi=topo_hi,
+                               engine="mesh-queued")
         self.integrations += 1
         # rounds newly covered by this dispatch: a DAG fact (last_round
         # delta), so the histogram is byte-identical across same-seed

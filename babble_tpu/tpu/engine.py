@@ -483,6 +483,8 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         # (demotion discards the queue, not the running worker) would
         # otherwise interleave collectives with this program and
         # deadlock the mesh (tpu/dispatch.py _MESH_EXEC_LOCK)
+        from .sharded import sharded_engine_tag
+
         _t1 = clock.monotonic()
         _dbl_stats = None
         with _MESH_EXEC_LOCK:
@@ -499,6 +501,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
                     res = sharded_frontier_passes(mesh, grid)
                 else:
                     res = sharded_run_passes(mesh, grid)
+        _engine = sharded_engine_tag(mesh, doubling=_dbl_stats is not None)
         _run_s = clock.monotonic() - _t1
         _m_run.labels(path="mesh").observe(_run_s)
         if _dbl_stats is not None:
@@ -516,6 +519,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         from .doubling import observe_catchup, run_doubling_passes, use_doubling
 
         res = None
+        _engine = "oneshot"
         if use_doubling(grid):
             _t1 = clock.monotonic()
             _dbl_stats = {}
@@ -527,6 +531,7 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
                 _run_s = clock.monotonic() - _t1
                 _m_run.labels(path="oneshot").observe(_run_s)
                 observe_catchup(obs, _dbl_stats, _run_s)
+                _engine = "doubling"
         if res is None and _frontier_safe(grid):
             _t1 = clock.monotonic()
             res = run_frontier_passes(grid, d_max=d_max)
@@ -536,13 +541,19 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
             res = run_passes(grid, d_max=d_max, bucketed=True, adaptive_r=True)
             _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
 
-    integrate_pass_results(hg, grid, res)
+    integrate_pass_results(hg, grid, res, engine=_engine)
 
 
-def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None:
+def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None,
+                           engine: str = "device") -> None:
     """Write device pass results back into the host hashgraph and run the
     host passes 4-5 — the shared integration tail of every one-shot-style
     device call.
+
+    `engine` labels the decision-provenance capture (obs/provenance.py):
+    every cell below is fingerprinted from the ALREADY-FETCHED host numpy
+    buffers (res.* / grid.*) as it is stamped, so provenance adds no
+    device work and no host syncs to the staged paths.
 
     `topo_hi` (the hashgraph's topological index at STAGING time) is the
     queued-dispatch escape hatch (tpu/dispatch.py): by integration time
@@ -569,6 +580,8 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
     undetermined = set(hg.undetermined_events)
     row_of = {h: r for r, h in enumerate(grid.hashes)}
     round_infos = {}
+    prov = hg.obs.provenance
+    prov_cells = 0
     for r in range(grid.e):  # rows are topo-ordered
         h = grid.hashes[r]
         ev = hg.store.get_event(h)
@@ -577,6 +590,11 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
         hg.store.set_event(ev)
         if h in undetermined:
             rnum = int(res.rounds[r])
+            prov_cells += prov.note_event(
+                h, rnum, int(res.lamport[r]), grid.last_ancestors[r],
+            )
+            if bool(res.witness[r]):
+                prov_cells += prov.note_witness(h, rnum, int(grid.creator[r]))
             ri = round_infos.get(rnum)
             if ri is None:
                 try:
@@ -619,6 +637,8 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
         # reset ages out.
         for rnum, ri in round_infos.items():
             hg.store.set_round(rnum, ri)
+        if prov_cells:
+            prov.mark("prov.capture", engine=engine, cells=prov_cells)
         hg.decide_fame()
         hg.decide_round_received()
         hg.process_decided_rounds()
@@ -640,6 +660,10 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
                 continue
             if res.fame_decided[ti, c]:
                 ri.set_fame(grid.hashes[wrow], bool(res.famous[ti, c]))
+                prov_cells += prov.note_fame(
+                    grid.hashes[wrow], pr.index, bool(res.famous[ti, c]),
+                    engine=engine,
+                )
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
     undecided_pending = [
@@ -696,6 +720,7 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
             if rr >= 0:
                 ev = hg.store.get_event(h)
                 ev.set_round_received(rr)
+                prov_cells += prov.note_received(h, rr)
                 hg.store.set_event(ev)
                 tri = round_infos.get(rr)
                 if tri is None:
@@ -716,6 +741,9 @@ def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None) -> None
         for rnum, ri in round_infos.items():
             hg.store.set_round(rnum, ri)
         hg.decide_round_received()
+
+    if prov_cells:
+        prov.mark("prov.capture", engine=engine, cells=prov_cells)
 
     # --- host passes 4-5 ---
     hg.process_decided_rounds()

@@ -1083,6 +1083,10 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
     )
     undetermined = set(hg.undetermined_events)
     round_infos: Dict[int, RoundInfo] = {}
+    # decision provenance (obs/provenance.py): cells captured from the
+    # fetched host buffers / host store only — no extra device syncs
+    prov = hg.obs.provenance
+    prov_cells = 0
     for row in new_rows:
         h = hashes[row]
         ev = hg.store.get_event(h)
@@ -1094,6 +1098,14 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
         else:
             rnum = ev.round
         if h in undetermined:
+            if ev.lamport_timestamp is not None and ev.last_ancestors is not None:
+                prov_cells += prov.note_event(
+                    h, rnum, ev.lamport_timestamp, ev.last_ancestors,
+                )
+            if bool(at(row, witness_w)):
+                prov_cells += prov.note_witness(
+                    h, rnum, hg.peer_position(ev.creator()),
+                )
             ri = round_infos.get(rnum)
             if ri is None:
                 try:
@@ -1137,6 +1149,10 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
                     continue
                 if fame_decided[sh, c]:
                     ri.set_fame(hashes[wrow], bool(famous[sh, c]))
+                    prov_cells += prov.note_fame(
+                        hashes[wrow], pr.index, bool(famous[sh, c]),
+                        engine="live",
+                    )
         if ri.witnesses_decided():
             decided_rounds.add(pr.index)
     for pr in hg.pending_rounds:
@@ -1190,6 +1206,7 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
                     rr += base
                     ev = hg.store.get_event(h)
                     ev.set_round_received(rr)
+                    prov_cells += prov.note_received(h, rr)
                     hg.store.set_event(ev)
                     tri = round_infos.get(rr)
                     if tri is None:
@@ -1211,6 +1228,8 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
                 hg.store.set_round(rnum, ri)
             hg.decide_round_received()
 
+    if prov_cells:
+        prov.mark("prov.capture", engine="live", cells=prov_cells)
     return last_round_rel
 
 

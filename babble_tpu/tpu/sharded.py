@@ -81,6 +81,15 @@ def mesh_validator_shards(mesh: Mesh) -> int:
     return int(mesh.shape[v_axis]) if v_axis is not None else 1
 
 
+def sharded_engine_tag(mesh: Mesh, doubling: bool = False) -> str:
+    """Engine label for decision-provenance capture: distinguishes the
+    1-D event-sharded layout from the 2-D validator-sharded one (and the
+    sharded doubling cold path), so a bisected divergence names the mesh
+    discipline that produced the bad cell."""
+    tag = "mesh2d" if mesh_validator_shards(mesh) > 1 else "mesh"
+    return tag + "-doubling" if doubling else tag
+
+
 def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
     out = np.full((size,) + a.shape[1:], fill, dtype=a.dtype)
     out[: a.shape[0]] = a

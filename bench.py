@@ -207,8 +207,27 @@ def main(argv=None):
     assert res.rounds_decided[: max(res.last_round - 6, 0)].all(), (
         "fame undecided in settled region"
     )
-    np.testing.assert_array_equal(np.asarray(out.rounds), res.rounds)
-    np.testing.assert_array_equal(np.asarray(out.received), res.received)
+    try:
+        np.testing.assert_array_equal(np.asarray(out.rounds), res.rounds)
+        np.testing.assert_array_equal(np.asarray(out.received), res.received)
+    except AssertionError:
+        # first-divergence bisection (obs/provenance.py): name the
+        # earliest divergent (pass, table, round, witness) cell before
+        # re-raising, so the gate failure is localized, not just detected
+        from babble_tpu.obs import bisect_pass_results
+
+        loc, bisect_path = bisect_pass_results(
+            grid, "device-loop", out, "engine", res, label="bench",
+        )
+        if loc is not None:
+            print(
+                "bisected: round %s %s/%s cell %s (%s)" % (
+                    loc["round"], loc["pass"], loc["table"],
+                    (loc.get("cell") or "")[:18], bisect_path,
+                ),
+                file=sys.stderr,
+            )
+        raise
 
     events_per_sec = grid.e / elapsed
 

@@ -56,12 +56,32 @@ def _best(fn, reps=REPS):
     return best
 
 
-def _assert_equal(a, b, what):
+def _assert_equal(a, b, what, grid=None, a_name="a", b_name="b"):
     import numpy as np
+
+    def _fail(msg):
+        # first-divergence bisection (obs/provenance.py): when the grid
+        # is supplied, name the earliest divergent cell so the equality
+        # gate reports a localization, not just a field name
+        if grid is not None:
+            from babble_tpu.obs import bisect_pass_results
+
+            loc, path = bisect_pass_results(
+                grid, a_name, a, b_name, b,
+                label=what.replace(" ", "-").replace(":", ""),
+            )
+            if loc is not None:
+                msg += (
+                    "; localized to round %s %s/%s cell %s (%s)" % (
+                        loc["round"], loc["pass"], loc["table"],
+                        (loc.get("cell") or "")[:18], path,
+                    )
+                )
+        raise AssertionError(msg)
 
     for f in ("rounds", "witness", "received"):
         if not bool((np.asarray(getattr(a, f)) == np.asarray(getattr(b, f))).all()):
-            raise AssertionError(f"{what}: {f} mismatch")
+            _fail(f"{what}: {f} mismatch")
     if int(a.last_round) != int(b.last_round):
         raise AssertionError(f"{what}: last_round mismatch")
 
@@ -108,10 +128,12 @@ def bench_fixture(grid, obs, label, base):
     dres = run_doubling_passes(grid, stats=stats)
     ref = run_passes(grid) if depth <= LEVEL_SCAN_MAX_DEPTH else None
     if ref is not None:
-        _assert_equal(dres, ref, f"{label}: doubling vs level scan")
+        _assert_equal(dres, ref, f"{label}: doubling vs level scan",
+                      grid=grid, a_name="doubling", b_name="levelscan")
     if base:
         fres = run_frontier_passes(grid)
-        _assert_equal(dres, fres, f"{label}: doubling vs frontier")
+        _assert_equal(dres, fres, f"{label}: doubling vs frontier",
+                      grid=grid, a_name="doubling", b_name="frontier")
 
     pass_cap = 3 * math.log2(max(depth, 2)) + 16
     if stats["passes"] > pass_cap:

@@ -68,6 +68,25 @@ ANCHOR_N = 64       # catch-up anchor: validators (smallest sweep rung)
 GOSSIP_INTERVAL_S = 0.005
 
 
+def _bisect_gate(grid, out, ref, label):
+    """On an oracle-gate failure: bisect the two result streams to the
+    earliest divergent (pass, table, round, witness) cell and export the
+    triage artifact (obs/provenance.py) before the caller re-raises."""
+    from babble_tpu.obs import bisect_pass_results
+
+    loc, path = bisect_pass_results(
+        grid, "device", out, "oracle", ref, label=label,
+    )
+    if loc is not None:
+        print(
+            "bisected: round %s %s/%s cell %s (%s)" % (
+                loc["round"], loc["pass"], loc["table"],
+                (loc.get("cell") or "")[:18], path,
+            ),
+            file=sys.stderr,
+        )
+
+
 def slo_gate(obs, min_rounds: float):
     """Declare the rounds-per-dispatch floor and evaluate once. Returns
     (ok, status_doc)."""
@@ -124,13 +143,17 @@ def run_sweep_point(mesh, n, events, oracle_cache):
         return build_levels(n, grid.self_parent, grid.other_parent)
 
     def gate(out):
-        np.testing.assert_array_equal(
-            np.asarray(out.rounds), np.asarray(ref.rounds)
-        )
-        np.testing.assert_array_equal(
-            np.asarray(out.received), np.asarray(ref.received)
-        )
-        assert int(out.last_round) == int(ref.last_round)
+        try:
+            np.testing.assert_array_equal(
+                np.asarray(out.rounds), np.asarray(ref.rounds)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out.received), np.asarray(ref.received)
+            )
+            assert int(out.last_round) == int(ref.last_round)
+        except AssertionError:
+            _bisect_gate(grid, out, ref, f"mesh-sweep-n{n}")
+            raise
 
     # compile + warm both device paths outside the timed loops
     gate(sharded_frontier_passes(mesh, grid))
@@ -226,13 +249,17 @@ def run_catchup_anchor(mesh, events, rpd_hist):
     total_rounds = int(ref.last_round) + 1
 
     def gate(out):
-        np.testing.assert_array_equal(
-            np.asarray(out.rounds), np.asarray(ref.rounds)
-        )
-        np.testing.assert_array_equal(
-            np.asarray(out.received), np.asarray(ref.received)
-        )
-        assert int(out.last_round) == int(ref.last_round)
+        try:
+            np.testing.assert_array_equal(
+                np.asarray(out.rounds), np.asarray(ref.rounds)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out.received), np.asarray(ref.received)
+            )
+            assert int(out.last_round) == int(ref.last_round)
+        except AssertionError:
+            _bisect_gate(grid, out, ref, "mesh-catchup-anchor")
+            raise
 
     gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())  # compile
 

@@ -26,6 +26,13 @@ python -m babble_tpu lint || rc=1
 echo "== babble-tpu race certification (hard gate) =="
 python -m babble_tpu lint --races --race-seeds "${BABBLE_RACE_SEEDS:-5}" || rc=1
 
+# Divergence-bisector self-test (hard gate, ISSUE 14): per seed, a clean
+# synthetic provenance stream pair must localize nothing and a seeded
+# single-cell fame flip must localize to exactly the injected
+# (pass, table, round, witness) cell. Sub-second and jax-free.
+echo "== babble-tpu bisector smoke (hard gate) =="
+python -m babble_tpu explain --smoke "${BABBLE_BISECT_SEEDS:-3}" || rc=1
+
 echo "== ruff (advisory) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"

@@ -1,0 +1,49 @@
+"""Seeded consensus divergence for the bisector tests (ISSUE 14).
+
+`broken_fame_passes` is DELIBERATELY wrong: behind its flag it runs the
+real device engine and then flips exactly one decided famous verdict —
+the synthetic "miscompiled kernel step" the first-divergence bisector
+exists to localize. It lives under tests/ (outside the lint scope, like
+fixtures_races.py) so the real tree stays clean, and exists to prove
+the bisector localizes an injected defect to its exact
+(pass, table, round, witness) cell.
+
+Do not fix it.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+import numpy as np
+
+
+def broken_fame_passes(grid, flip: bool = True, seed: int = 0):
+    """Run the real engine on `grid`; when `flip`, corrupt one decided
+    famous bit chosen by a seeded PRNG. Returns
+    ``(res, injected)`` where `injected` is the corrupted cell as
+    ``(absolute_round, witness_hash)`` — or None when `flip` is False
+    (the clean control arm)."""
+    from babble_tpu.obs.provenance import grid_cell_keys
+    from babble_tpu.tpu.engine import run_passes
+
+    res = run_passes(grid)
+    if not flip:
+        return res, None
+    candidates = []
+    round_offset = int(getattr(res, "round_offset", 0))
+    for ti in range(res.witness_table.shape[0]):
+        for c in range(res.witness_table.shape[1]):
+            wrow = int(res.witness_table[ti, c])
+            if wrow >= 0 and bool(res.fame_decided[ti, c]):
+                candidates.append((ti, c, wrow))
+    assert candidates, "fixture grid decided no fame at all"
+    rng = random.Random(seed)
+    ti, c, wrow = candidates[rng.randrange(len(candidates))]
+    famous = np.array(res.famous, copy=True)
+    famous[ti, c] = not bool(famous[ti, c])
+    return (
+        replace(res, famous=famous),
+        (ti + round_offset, grid_cell_keys(grid)[wrow]),
+    )

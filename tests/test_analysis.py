@@ -505,6 +505,26 @@ def test_obs_slo_decl_rule(tmp_path):
     assert any("series=" in f.message for f in decls)
 
 
+def test_obs_prov_static_name_rule(tmp_path):
+    # provenance marks need literal names (they feed the mark catalog
+    # and the provenance stream fingerprint); a reasoned waiver
+    # suppresses, foreign .mark receivers are not ours
+    found = _findings(
+        tmp_path, "babble_tpu/node/fixture.py", """\
+        def emit(obs, prov, kind, parser):
+            obs.provenance.mark("prov." + kind, cells=1)
+            prov.mark("prov.capture", engine="live")
+            prov.mark(f"dyn.{kind}")  # obs-ok: kinds are a literal enum
+            parser.mark(kind)
+        """,
+    )
+    marks = [f for f in found if f.rule == "obs-prov-static-name"]
+    assert [(f.rule, f.line) for f in marks] == [
+        ("obs-prov-static-name", 2),
+    ]
+    assert "static string literals" in marks[0].message
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------

@@ -1,0 +1,487 @@
+"""Consensus decision provenance + first-divergence bisection (ISSUE 14).
+
+Four layers under test:
+
+- the `ProvenanceRecorder` itself: cell capture, bounded retention with
+  clean truncation, fingerprints, the dossier;
+- the `DivergenceBisector`: causal ordering, missing-round handling,
+  deterministic localization, the CI smoke;
+- the cross-engine comparability contract: CPU oracle hooks vs every
+  device path must converge to byte-identical table streams, and the
+  seeded defect fixture (fixtures_divergence.py) must localize to its
+  exact injected cell with byte-identical repeat-run artifacts;
+- the integration surfaces: sim determinism fingerprint, fault-plan
+  stream completeness, watchdog stall provenance, the commit-latency
+  exemplar, `/debug/explain`, and the `explain` CLI.
+"""
+
+import json
+import logging
+import os
+import urllib.request
+
+import pytest
+
+from babble_tpu.obs import (
+    DivergenceBisector,
+    Observability,
+    ProvenanceRecorder,
+    bisect_pass_results,
+    capture_pass_results,
+    run_bisector_smoke,
+)
+from babble_tpu.sim import SimClock
+
+from fixtures_divergence import broken_fame_passes
+
+H = [("%02x" % i) * 8 for i in range(16)]  # distinct stable cell keys
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_cells_idempotent_and_fingerprints():
+    prov = ProvenanceRecorder(clock=SimClock(), node_id=0)
+    assert prov.note_event(H[0], 0, 3, [(1, "x"), (2, "y")])  # host tuples
+    assert not prov.note_event(H[0], 0, 3, [1, 2])  # grid ints, same value
+    assert prov.note_witness(H[0], 0, 1)
+    assert prov.note_fame(H[0], 0, True, engine="cpu", voter=H[1], yays=3)
+    assert not prov.note_fame(H[0], 0, True)  # unchanged -> no append
+    assert prov.note_received(H[0], 0)
+    fp1 = prov.round_fingerprint(0)
+    assert fp1 and prov.round_fingerprint(7) is None
+    # the why is engine-specific and excluded from the table fingerprint
+    other = ProvenanceRecorder(clock=SimClock(), node_id=1)
+    other.note_event(H[0], 0, 3, [1, 2])
+    other.note_witness(H[0], 0, 1)
+    other.note_fame(H[0], 0, True, engine="mesh2d")
+    other.note_received(H[0], 0)
+    assert other.round_fingerprint(0) == fp1
+    assert other.table_bytes() == prov.table_bytes()
+    # ... but the full stream differs (whys differ)
+    assert other.stream_bytes() != prov.stream_bytes()
+    doc = prov.explain_round(0)
+    assert doc["known"] and doc["fingerprint"] == fp1
+    assert doc["why"][H[0]]["voter"] == H[1]
+    assert prov.explain_round(7) == {
+        "node": 0, "round": 7, "known": False, "evicted_below": 0,
+    }
+
+
+def test_recorder_eviction_is_cleanly_truncated():
+    prov = ProvenanceRecorder(clock=SimClock(), round_cap=4)
+    for r in range(10):
+        prov.note_witness(H[r % len(H)], r, r % 4)
+        prov.settle_round(r)
+    assert prov.rounds() == [6, 7, 8, 9]
+    assert prov.evicted_rounds == 6 and prov.evicted_below == 6
+    truncs = [m for m in prov.to_json()["marks"]
+              if m["name"] == "prov.truncate"]
+    assert [m["fields"]["round"] for m in truncs] == list(range(6))
+    assert prov.verify_complete_or_truncated() == []
+
+
+def test_recorder_integrity_flags_orphan_fame():
+    prov = ProvenanceRecorder(clock=SimClock())
+    prov.note_fame(H[0], 2, True)  # no witness cell backs it
+    issues = prov.verify_complete_or_truncated()
+    assert len(issues) == 1 and "no witness cell" in issues[0]
+
+
+# ---------------------------------------------------------------------------
+# bisector
+# ---------------------------------------------------------------------------
+
+
+def _two(mutate=None):
+    a = ProvenanceRecorder(clock=SimClock())
+    b = ProvenanceRecorder(clock=SimClock())
+    for prov in (a, b):
+        for r in range(3):
+            for c in range(3):
+                h = H[r * 3 + c]
+                prov.note_event(h, r, r * 3 + c, [1, 2, 3])
+                prov.note_witness(h, r, c)
+                prov.note_fame(h, r, True, engine="x", voter=H[15])
+                prov.note_received(h, r)
+    if mutate:
+        mutate(b)
+    return a, b
+
+
+def test_bisector_clean_pair_localizes_nothing():
+    a, b = _two()
+    assert DivergenceBisector().bisect(
+        "a", a.to_json(), "b", b.to_json()
+    ) is None
+
+
+def test_bisector_pass_order_earliest_wins():
+    # corrupt BOTH a round-1 lastAncestors cell and a round-1 fame cell:
+    # causal pass order must name lastAncestors, the upstream table
+    def mutate(b):
+        rp = b.round_provenance(1)
+        rp.tables["lastAncestors"][H[4]] = [99, 9, 9, 9]
+        rp.tables["fame"][H[5]] = False
+
+    a, b = _two(mutate)
+    loc = DivergenceBisector().bisect("a", a.to_json(), "b", b.to_json())
+    assert (loc["round"], loc["pass"], loc["table"], loc["cell"]) == (
+        1, "divide", "lastAncestors", H[4],
+    )
+    assert loc["kind"] == "value-mismatch"
+    # the fame divergence carries the deciding why context
+    rp = b.round_provenance(1)
+    rp.tables["lastAncestors"][H[4]] = [4, 1, 2, 3]  # heal upstream
+    loc = DivergenceBisector().bisect("a", a.to_json(), "b", b.to_json())
+    assert (loc["table"], loc["cell"]) == ("fame", H[5])
+    assert loc["voter"] == H[15]
+    assert loc["why"]["a"]["voter"] == H[15]
+
+
+def test_bisector_skips_unretained_rounds_flags_missing_ones():
+    # b evicted rounds 0-1 (bounded recorder): not comparable, skipped
+    a = ProvenanceRecorder(clock=SimClock())
+    b = ProvenanceRecorder(clock=SimClock(), round_cap=4)
+    for prov, rounds in ((a, range(6)), (b, range(10))):
+        for r in rounds:
+            prov.note_witness(H[r % len(H)], r, 0)
+    assert b.evicted_below == 6
+    # common comparable window is empty of disagreement -> None
+    assert DivergenceBisector().bisect(
+        "a", a.to_json(), "b", b.to_json()
+    ) is None
+    # a hole INSIDE the window is a real finding
+    a2, b2 = _two()
+    del b2._rounds[1]  # white-box: simulate a dropped round
+    loc = DivergenceBisector().bisect("a", a2.to_json(), "b", b2.to_json())
+    assert loc["kind"] == "missing-round" and loc["round"] == 1
+    assert (loc["a"], loc["b"]) == ("present", "absent")
+
+
+def test_bisector_smoke_is_the_ci_gate():
+    assert run_bisector_smoke(seeds=3) == []
+
+
+# ---------------------------------------------------------------------------
+# cross-engine comparability + the seeded defect fixture
+# ---------------------------------------------------------------------------
+
+
+def _cpu_vs_device(init):
+    from babble_tpu.tpu import run_consensus_device
+    from test_tpu_differential import clone_hashgraph
+
+    r = init()
+    hg = r[0] if isinstance(r, tuple) else r
+    cpu, dev = clone_hashgraph(hg), clone_hashgraph(hg)
+    cpu.commit_callback = lambda b: None
+    dev.commit_callback = lambda b: None
+    cpu.run_consensus()
+    run_consensus_device(dev)
+    return cpu, dev
+
+
+@pytest.mark.parametrize("fixture", ["consensus", "funky"])
+def test_cpu_and_device_table_streams_byte_identical(fixture):
+    from dsl import init_consensus_hashgraph, init_funky_hashgraph
+
+    init = {
+        "consensus": init_consensus_hashgraph,
+        "funky": lambda: init_funky_hashgraph(full=True),
+    }[fixture]
+    cpu, dev = _cpu_vs_device(init)
+    pc, pd = cpu.obs.provenance, dev.obs.provenance
+    assert pc.rounds() == pd.rounds() and pc.rounds()
+    assert pc.table_bytes() == pd.table_bytes()
+    assert pc.table_fingerprint() == pd.table_fingerprint()
+    # the bisector agrees: nothing to localize between the engines
+    assert DivergenceBisector().bisect(
+        "cpu", pc.to_json(), "device", pd.to_json()
+    ) is None
+    # the CPU oracle recorded rich deciding context for the fame cells
+    whys = [
+        rp.why for r in pc.rounds()
+        if (rp := pc.round_provenance(r)) and rp.why
+    ]
+    assert whys, "CPU oracle recorded no fame whys"
+    some = next(iter(whys[0].values()))
+    assert some["engine"] == "cpu"
+    assert {"voter", "yays", "nays", "ss", "step"} <= set(some)
+
+
+def test_seeded_defect_localizes_to_exact_cell(tmp_path):
+    from babble_tpu.tpu import synthetic_grid
+
+    grid = synthetic_grid(4, 120, seed=3)
+    clean, _ = broken_fame_passes(grid, flip=False)
+    # clean control arm: two captures of the same results -> zero findings
+    loc, path = bisect_pass_results(
+        grid, "a", clean, "b", clean, artifact_dir=str(tmp_path),
+        label="clean",
+    )
+    assert loc is None and path is None and not os.listdir(tmp_path)
+
+    broken, injected = broken_fame_passes(grid, flip=True, seed=3)
+    inj_round, inj_hash = injected
+    loc, path = bisect_pass_results(
+        grid, "good", clean, "bad", broken, artifact_dir=str(tmp_path),
+        label="seeded",
+    )
+    assert (loc["round"], loc["pass"], loc["table"], loc["cell"]) == (
+        inj_round, "fame", "fame", inj_hash,
+    )
+    # deterministic artifact name, byte-identical across repeat runs
+    assert os.path.basename(path) == "bisect-seeded-good-vs-bad.json"
+    with open(path, "rb") as f:
+        first = f.read()
+    doc = json.loads(first)
+    assert doc["kind"] == "babble-tpu-divergence-localization"
+    assert doc["localized"]["cell"] == inj_hash
+    _, path2 = bisect_pass_results(
+        grid, "good", clean, "bad", broken, artifact_dir=str(tmp_path),
+        label="seeded",
+    )
+    with open(path2, "rb") as f:
+        assert f.read() == first
+
+
+# ---------------------------------------------------------------------------
+# sim integration
+# ---------------------------------------------------------------------------
+
+
+def test_sim_provenance_fingerprint_deterministic_per_backend():
+    from babble_tpu.sim import run_one
+
+    a = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    b = run_one(5, plan="lossy", n=4, until=None, target_block=3)
+    assert a["ok"] and b["ok"]
+    assert "provenance_fingerprint" in a
+    assert a["provenance_fingerprint"] == b["provenance_fingerprint"]
+    assert a["localized"] is None and a["bisect_artifact"] is None
+    # a different seed moves the stream
+    c = run_one(6, plan="lossy", n=4, until=None, target_block=3)
+    assert c["provenance_fingerprint"] != a["provenance_fingerprint"]
+
+
+@pytest.mark.parametrize("preset", ["lossy", "partition_heal"])
+def test_fault_plans_keep_streams_complete_or_truncated(preset):
+    from babble_tpu.sim import SimCluster, preset_plan
+
+    cluster = SimCluster(n=4, seed=7, plan=preset_plan(preset, 4))
+    try:
+        cluster.run(until=None, target_block=3)
+        for sn in cluster.sns:
+            if sn.node is None:
+                continue
+            prov = sn.node.obs.provenance
+            assert prov.verify_complete_or_truncated() == []
+            assert prov.rounds(), f"{sn.name} recorded no provenance"
+    finally:
+        cluster.shutdown()
+
+
+def test_sim_export_provenance_artifacts(tmp_path):
+    from babble_tpu.sim import SimCluster, preset_plan
+
+    cluster = SimCluster(n=4, seed=2, plan=preset_plan("clean", 4))
+    try:
+        cluster.run(until=None, target_block=2)
+        paths = cluster.export_provenance(str(tmp_path))
+        assert len(paths) == 4
+        assert os.path.basename(paths[0]) == "provenance-seed2-node0.json"
+        with open(paths[0]) as f:
+            doc = json.load(f)
+        assert doc["rounds"] and doc["evicted_below"] == 0
+        # the exported docs are bisector food. Live nodes legitimately
+        # trail each other at the unsettled tail, so the cross-node
+        # agreement contract holds over the commonly SETTLED rounds:
+        # restricted to those, all four nodes localize nothing.
+        docs = []
+        for p in paths:
+            with open(p) as f:
+                docs.append((os.path.basename(p), json.load(f)))
+        finals = [
+            {r for r, v in d["rounds"].items() if v["final"]}
+            for _, d in docs
+        ]
+        common = set.intersection(*finals)
+        assert common, "no commonly settled rounds across the cluster"
+        views = [
+            (name, {
+                "evicted_below": 0,
+                "rounds": {
+                    r: v for r, v in d["rounds"].items() if r in common
+                },
+            })
+            for name, d in docs
+        ]
+        assert DivergenceBisector().localize(views) is None
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# watchdog stall provenance + commit-latency exemplar
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_stall_carries_round_provenance():
+    from babble_tpu.node.watchdog import LivenessWatchdog
+
+    clock = SimClock()
+    obs = Observability(clock=clock, node_id=0)
+    obs.provenance.note_witness(H[0], 3, 0)  # the stuck round's table
+    wd = LivenessWatchdog(
+        clock=clock, obs=obs, logger=logging.getLogger("test.wd"),
+        deadline=1.0, round_fn=lambda: 2, pending_fn=lambda: 5,
+    )
+    wd.check()
+    clock.advance_to(2.0)
+    assert wd.check() is True
+    recs = [r for r in obs.flightrec.to_json()["records"]
+            if r["name"] == "watchdog.stall"]
+    assert len(recs) == 1
+    f = recs[0]["fields"]
+    assert f["last_decided_round"] == 2 and f["stuck_round"] == 3
+    assert f["prov"] == obs.provenance.round_fingerprint(3)
+    dump = obs.flightrec.dump_docs[-1]
+    assert dump["reason"] == "consensus-stall"
+    assert dump["context"]["stuck_round"] == 3
+    assert dump["context"]["prov"] == f["prov"]
+
+
+def test_commit_latency_exemplar_links_to_trace():
+    from babble_tpu.obs.tracectx import trace_id_for
+    from babble_tpu.sim import SimCluster, preset_plan
+
+    cluster = SimCluster(n=4, seed=4, plan=preset_plan("clean", 4))
+    try:
+        cluster.run(until=None, target_block=2)
+        linked = 0
+        for sn in cluster.sns:
+            hist = sn.node._m_commit_latency
+            ex = hist.exemplar()
+            if ex is None:
+                continue  # node never committed its own traced tx
+            linked += 1
+            assert len(ex) == 16 and int(ex, 16) >= 0
+            text = sn.node.obs.registry.expose()
+            assert (
+                f'# EXEMPLAR babble_commit_latency_seconds trace_id="{ex}"'
+                in text
+            )
+            snap = sn.node.obs.registry.snapshot()
+            assert (
+                snap["babble_commit_latency_seconds"]["series"][""]["exemplar"]
+                == ex
+            )
+        assert linked, "no node attached a commit-latency exemplar"
+    finally:
+        cluster.shutdown()
+
+
+def test_histogram_exemplar_is_per_series_and_optional():
+    obs = Observability()
+    h = obs.histogram("x_seconds", "t", labels=("peer",))
+    h.labels(peer="a").observe(0.1, exemplar="cafe")
+    h.labels(peer="b").observe(0.2)
+    assert h.exemplar(peer="a") == "cafe"
+    assert h.exemplar(peer="b") is None
+    lines = h.render()
+    assert sum("# EXEMPLAR" in ln for ln in lines) == 1
+
+
+# ---------------------------------------------------------------------------
+# /debug/explain + CLI
+# ---------------------------------------------------------------------------
+
+
+class _Block:
+    def __init__(self, index, rr):
+        self._index, self._rr = index, rr
+
+    def index(self):
+        return self._index
+
+    def round_received(self):
+        return self._rr
+
+
+class _FakeNode:
+    def __init__(self, obs):
+        self.id = 0
+        self.obs = obs
+        self.clock = obs.clock
+
+    def get_stats(self):
+        return {"id": "0"}
+
+    def get_block(self, index):
+        if index != 12:
+            raise KeyError(index)
+        return _Block(12, 3)
+
+
+def _serve(node):
+    from babble_tpu.service import Service
+
+    return Service("127.0.0.1:0", node)
+
+
+def test_debug_explain_endpoint():
+    obs = Observability(node_id=0)
+    obs.provenance.note_witness(H[0], 3, 1)
+    obs.provenance.note_fame(H[0], 3, True, engine="cpu", voter=H[1],
+                             yays=3, nays=0, ss=4, step=2)
+    svc = _serve(_FakeNode(obs))
+    try:
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+        with urllib.request.urlopen(
+            base + "/debug/explain?block=12", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["block_index"] == 12 and doc["round"] == 3
+        assert doc["known"] and doc["tables"]["fame"][H[0]] is True
+        assert doc["why"][H[0]]["voter"] == H[1]
+        with urllib.request.urlopen(
+            base + "/debug/explain?round=9", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["known"] is False and doc["round"] == 9
+        # missing selector -> HTTP error, service stays up
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/debug/explain", timeout=5)
+    finally:
+        svc.shutdown()
+
+
+def test_explain_cli_smoke_and_offline_bisect(tmp_path, capsys):
+    from babble_tpu.cli import main
+
+    assert main(["explain", "--smoke", "3"]) == 0
+    assert "0 failures" in capsys.readouterr().out
+
+    a, b = _two()
+    rp = b.round_provenance(2)
+    rp.tables["fame"][H[6]] = False
+    pa, pb = tmp_path / "na.json", tmp_path / "nb.json"
+    pa.write_text(json.dumps(a.to_json()))
+    pb.write_text(json.dumps(b.to_json()))
+    assert main([
+        "explain", "--bisect", str(pa), str(pb),
+        "--artifact-dir", str(tmp_path),
+    ]) == 1
+    out = capsys.readouterr().out
+    loc = json.loads(out[: out.rindex("}") + 1])
+    assert (loc["round"], loc["table"], loc["cell"]) == (2, "fame", H[6])
+    assert (tmp_path / "bisect-na-vs-nb.json").exists()
+    # agreeing streams exit 0
+    pb.write_text(json.dumps(a.to_json()))
+    assert main([
+        "explain", "--bisect", str(pa), str(pb),
+    ]) == 0
