@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint race bench bench-mesh trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint race bench bench-mesh bench-ingest trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -45,6 +45,12 @@ bench:
 # archived as BENCH_MESH_r*.json, gated by the trend series below
 bench-mesh:
 	$(PY) bench_mesh_scale.py --slo
+
+# open-loop ingest bench (ISSUE 16): offered load through the ingress
+# pipeline on the sim fabric, gated on submit->commit p50/p99 and on
+# batched-vs-single-tx digest equality; archived as BENCH_INGEST_r*.json
+bench-ingest:
+	$(PY) bench_ingest.py --slo
 
 # cross-round perf-trend gate over the archived BENCH_r*/MULTICHIP_r*
 # artifacts: fails on a >10% regression against the best prior round

@@ -69,6 +69,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "babble_tpu.peers.json_peers",
     "babble_tpu.proxy.jsonrpc",
     "babble_tpu.proxy.dummy",
+    "babble_tpu.ingress.pipeline",
     "babble_tpu.service",
     "babble_tpu.tpu.dispatch",
     "babble_tpu.tpu.live",

@@ -52,6 +52,7 @@ LOCK_SCOPE_PREFIXES = (
     "babble_tpu/service.py",
     "babble_tpu/peers/",
     "babble_tpu/proxy/",
+    "babble_tpu/ingress/",
     "babble_tpu/tpu/dispatch.py",
     "babble_tpu/tpu/live.py",
     "babble_tpu/obs/",

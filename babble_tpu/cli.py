@@ -102,6 +102,22 @@ def build_parser() -> argparse.ArgumentParser:
                      help="With --mesh-devices N: fold the mesh into a 2-D "
                           "(validators, rounds) layout with this many "
                           "validator shards (must divide N; 1 = rounds-only)")
+    run.add_argument("--ingress-batch-bytes", type=int, default=65536,
+                     help="Byte threshold that releases an ingress batch "
+                          "to the tx worker; a single tx at/over it "
+                          "bypasses coalescing and ships alone")
+    run.add_argument("--ingress-batch-deadline", type=float, default=0.0,
+                     help="Hold a partial ingress batch up to this many "
+                          "seconds waiting for more submissions "
+                          "(0 = release on every pump)")
+    run.add_argument("--ingress-queue-cap", type=int, default=8192,
+                     help="Max transactions held in the ingress pipeline "
+                          "before submissions get the shed verdict "
+                          "(0 = unbounded)")
+    run.add_argument("--ingress-client-rate", type=float, default=0.0,
+                     help="Per-client token-bucket rate in tx/s (client = "
+                          "peer addr or app-supplied client_id); enables "
+                          "deficit-round-robin fairness (0 = unlimited)")
     run.add_argument("--metrics", action="store_true",
                      help="Log periodic metrics-registry snapshots at info "
                           "(the registry always serves GET /metrics on the "
@@ -233,6 +249,10 @@ def _merge_config_file(args: argparse.Namespace, argv=None) -> None:
         "dispatch-batch-deadline": "dispatch_batch_deadline",
         "dispatch-batch-rows": "dispatch_batch_rows",
         "mesh-validator-shards": "mesh_validator_shards",
+        "ingress-batch-bytes": "ingress_batch_bytes",
+        "ingress-batch-deadline": "ingress_batch_deadline",
+        "ingress-queue-cap": "ingress_queue_cap",
+        "ingress-client-rate": "ingress_client_rate",
     }
     for file_key, attr in mapping.items():
         if file_key in cfg and attr not in explicit:
@@ -274,6 +294,28 @@ def run_command(args: argparse.Namespace) -> int:
         )
         return 1
 
+    if args.ingress_batch_bytes < 1:
+        logger.error("--ingress-batch-bytes must be >= 1")
+        return 1
+    if args.ingress_batch_deadline < 0:
+        logger.error("--ingress-batch-deadline must be >= 0")
+        return 1
+    if args.ingress_queue_cap < 0:
+        logger.error("--ingress-queue-cap must be >= 0 (0 = unbounded)")
+        return 1
+    if args.ingress_client_rate < 0:
+        logger.error("--ingress-client-rate must be >= 0 (0 = unlimited)")
+        return 1
+    # contradiction, not something to silently ignore (the rate limiter's
+    # overrate shed bound is derived from the queue cap — unbounded
+    # admission with a per-client rate would park flooder backlogs forever)
+    if args.ingress_client_rate > 0 and args.ingress_queue_cap == 0:
+        logger.error(
+            "--ingress-client-rate requires --ingress-queue-cap > 0 "
+            "(rate limiting needs a bounded admission queue to shed into)"
+        )
+        return 1
+
     if args.standalone:
         proxy = InmemDummyClient(logger)
     else:
@@ -304,6 +346,10 @@ def run_command(args: argparse.Namespace) -> int:
             dispatch_batch_deadline=args.dispatch_batch_deadline,
             dispatch_batch_rows=args.dispatch_batch_rows,
             mesh_validator_shards=args.mesh_validator_shards,
+            ingress_batch_bytes=args.ingress_batch_bytes,
+            ingress_batch_deadline=args.ingress_batch_deadline,
+            ingress_queue_cap=args.ingress_queue_cap,
+            ingress_client_rate=args.ingress_client_rate,
             metrics_log=args.metrics,
             flightrec_dir=args.flightrec_dir or None,
             slo_enabled=not args.no_slo,

@@ -1,0 +1,34 @@
+"""Production ingress (ISSUE 16): the batched, back-pressured submit
+pipeline between every AppProxy submit entry point and the node's
+transaction worker, plus the open-loop load generator that drives it.
+
+- `pipeline.py` — IngressPipeline: size/deadline-bounded batching on the
+  injected Clock, bounded admission queue with explicit
+  accepted/queued/shed verdicts, per-client token buckets with
+  deficit-round-robin fairness, and trace_id dedup over an LRU window.
+- `loadgen.py` — OpenLoopLoadGen: Poisson arrivals at a fixed offered
+  rate (open-loop, so coordinated omission cannot hide queueing) over
+  the deterministic sim fabric or real TCP.
+"""
+
+from .pipeline import (
+    IngressPipeline,
+    IngressVerdict,
+    SubmitRejected,
+    VERDICT_ACCEPTED,
+    VERDICT_QUEUED,
+    VERDICT_SHED,
+    verdict_from_wire,
+)
+from .loadgen import OpenLoopLoadGen
+
+__all__ = [
+    "IngressPipeline",
+    "IngressVerdict",
+    "SubmitRejected",
+    "VERDICT_ACCEPTED",
+    "VERDICT_QUEUED",
+    "VERDICT_SHED",
+    "verdict_from_wire",
+    "OpenLoopLoadGen",
+]

@@ -1,0 +1,233 @@
+"""Open-loop load generator (ISSUE 16): Poisson arrivals at a fixed
+offered rate against the ingress pipeline.
+
+Open-loop is the point: a closed-loop generator (submit, wait, submit)
+slows down exactly when the system queues, so its latency numbers hide
+the queueing it caused — the coordinated-omission trap. Here arrival
+times come from a seeded exponential inter-arrival stream fixed up
+front; the system's backpressure answers (queued/shed verdicts) are
+RECORDED, never allowed to pace the offered load.
+
+Client population: each arrival is a burst of `burst` transactions from
+`burst` DISTINCT clients sampled from a `clients`-sized id space — how
+10^5..10^6 simulated clients are driven without 10^5 sockets. Distinct
+clients per burst also keeps release order equal to submission order
+under the pipeline's DRR scheduler (per-client FIFO is guaranteed;
+global FIFO only holds when no client appears twice in one burst), which
+the batched-vs-single-tx digest-equality gate in bench_ingest.py relies
+on.
+
+Two drivers off one deterministic schedule:
+
+- `drive_sim(cluster, ...)` — schedules arrivals on the SimScheduler
+  (virtual time). `via="ingress"` submits through the proxy's batch
+  entry (the pipeline path); `via="direct"` bypasses the pipeline and
+  feeds the raw submit queue — the single-tx control for digest
+  equality. Injected retries exercise the dedup window on the ingress
+  path and are skipped on the direct path (the pipeline filters them,
+  so the unique workload is identical either way).
+- `drive_tcp(proxy, ...)` — same arrival law over a real
+  SocketBabbleProxy: paced on the system Clock, batches shipped with
+  `Babble.SubmitTxBatch`.
+
+All randomness comes from `random.Random(f"{seed}|loadgen")`: the same
+seed offers the same transactions at the same times to the same clients.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ..common import Clock, SYSTEM_CLOCK
+
+
+class OpenLoopLoadGen:
+    """Deterministic Poisson arrival schedule + verdict bookkeeping."""
+
+    def __init__(
+        self,
+        rate: float,
+        clients: int = 100_000,
+        burst: int = 8,
+        tx_bytes: int = 32,
+        retry_every: int = 0,
+        seed: int = 0,
+    ):
+        if rate <= 0:
+            raise ValueError("rate must be > 0 (offered tx/s)")
+        if clients < 1:
+            raise ValueError("clients must be >= 1")
+        if burst < 1:
+            raise ValueError("burst must be >= 1")
+        self.rate = rate
+        self.clients = clients
+        self.burst = min(burst, clients)  # distinct clients per burst
+        self.tx_bytes = max(16, tx_bytes)
+        # every Nth burst re-offers its first tx (a client retry) to
+        # exercise the dedup window; 0 disables
+        self.retry_every = retry_every
+        self.rng = random.Random(f"{seed}|loadgen")
+        self.seq = 0
+        self.bursts = 0
+        self.offered = 0
+        self.retries = 0
+        self._last_tx: Optional[bytes] = None
+        self.verdicts: Dict[str, int] = {
+            "accepted": 0, "queued": 0, "shed": 0, "deduped": 0,
+        }
+
+    # -- schedule ------------------------------------------------------
+
+    def next_gap(self) -> float:
+        """Exponential inter-arrival gap between BURSTS, sized so the
+        offered tx rate (bursts * burst size) matches `rate`."""
+        return self.rng.expovariate(self.rate / self.burst)
+
+    def next_burst(self) -> List[Dict[str, Any]]:
+        """[{tx, client_id}] for one arrival: `burst` fresh txs from
+        distinct clients."""
+        ids = self.rng.sample(range(self.clients), self.burst)
+        out = []
+        for cid in ids:
+            body = b"lg|%d|c%d" % (self.seq, cid)
+            tx = body + b"." * max(0, self.tx_bytes - len(body))
+            self.seq += 1
+            out.append({"tx": tx, "client_id": f"c{cid}"})
+        self.bursts += 1
+        self.offered += len(out)
+        return out
+
+    def want_retry(self) -> bool:
+        """Whether this arrival should also re-offer a previously
+        submitted tx (drawn every `retry_every` bursts). The DRIVER owns
+        which tx and where: a retry must go to the node that saw the
+        original, because dedup windows are per-node — re-offering to a
+        different node is a fresh submission, not a retry."""
+        return bool(self.retry_every) and self.bursts % self.retry_every == 0
+
+    def note(self, verdict) -> None:
+        if getattr(verdict, "deduped", False):
+            self.verdicts["deduped"] += 1
+        else:
+            self.verdicts[verdict.verdict] = (
+                self.verdicts.get(verdict.verdict, 0) + 1
+            )
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "offered": self.offered,
+            "bursts": self.bursts,
+            "retries": self.retries,
+            "clients": self.clients,
+            "rate": self.rate,
+            "verdicts": dict(self.verdicts),
+        }
+
+    # -- sim driver (virtual time) -------------------------------------
+
+    def drive_sim(
+        self, cluster, until: float, via: str = "ingress",
+    ) -> "OpenLoopLoadGen":
+        """Schedule the arrival stream on the cluster's SimScheduler.
+        Returns self (stats accumulate as the cluster runs). Target node
+        per burst is drawn from the same seeded stream, so the ingress
+        and direct runs offer identical (tx, node, time) triples."""
+        if via not in ("ingress", "direct"):
+            raise ValueError("via must be 'ingress' or 'direct'")
+        # (tx, client_id, node_index) of the last DELIVERED burst's first
+        # tx — the retry source. Per-node dedup means a retry only counts
+        # as a retry when it lands on the node that saw the original.
+        last: List[Any] = [None]
+
+        def arrival() -> None:
+            if cluster.clock.now >= until:
+                return
+            burst = self.next_burst()
+            want_retry = self.want_retry()
+            i = self.rng.randrange(cluster.n)
+            sn = cluster.sns[i]
+            if not sn.crashed:
+                if via == "ingress":
+                    # one wire batch per burst: per-tx verdicts, one
+                    # pump, one (or few) released downstream batches
+                    for v in sn.proxy.submit_tx_batch(
+                        [e["tx"] for e in burst],
+                        client_id=burst[0]["client_id"],
+                    ):
+                        self.note(v)
+                else:
+                    # single-tx control: the raw pre-pipeline path, one
+                    # queue put per tx
+                    for entry in burst:
+                        tx = bytes(entry["tx"])
+                        sn.proxy._trace_submit(tx)
+                        sn.proxy.submit_ch().put(tx)
+                last[0] = (burst[0]["tx"], burst[0]["client_id"], i)
+            # client retry: re-offer an already-delivered tx TO THE NODE
+            # THAT SAW IT. On the ingress path its dedup window absorbs
+            # it (verdict accepted/deduped, nothing re-enters the pool);
+            # the direct path skips it — so the unique workload, and the
+            # commit digests, match between the two modes.
+            if want_retry and last[0] is not None and via == "ingress":
+                rtx, rcid, rnode = last[0]
+                rsn = cluster.sns[rnode]
+                if not rsn.crashed:
+                    self.retries += 1
+                    for v in rsn.proxy.submit_tx_batch(
+                        [rtx], client_id=rcid
+                    ):
+                        self.note(v)
+            # open loop: the next arrival is scheduled regardless of
+            # what the verdicts said
+            cluster.sched.after(self.next_gap(), arrival, label="loadgen")
+
+        cluster.sched.after(self.next_gap(), arrival, label="loadgen")
+        return self
+
+    # -- TCP driver (wall clock through the Clock seam) ----------------
+
+    def drive_tcp(
+        self, proxy, duration: float, clock: Clock = SYSTEM_CLOCK,
+    ) -> Dict[str, Any]:
+        """Offer the arrival stream to a live node through an app-side
+        SocketBabbleProxy (`Babble.SubmitTxBatch`). Arrival times are
+        fixed up front from the schedule; when the generator falls
+        behind wall clock (slow RPCs), pending arrivals are sent
+        back-to-back rather than skipped — offered load is preserved,
+        not thinned (that would be coordinated omission again)."""
+        from .pipeline import SubmitRejected
+
+        start = clock.monotonic()
+        next_at = start + self.next_gap()
+        errors = 0
+        while True:
+            now = clock.monotonic()
+            if now >= start + duration:
+                break
+            if next_at > now:
+                clock.sleep(min(next_at - now, start + duration - now))
+                continue
+            burst = self.next_burst()
+            txs = [e["tx"] for e in burst]
+            # single target node over TCP: a retry of the last delivered
+            # tx rides along and is absorbed by that node's dedup window
+            if self.want_retry() and self._last_tx is not None:
+                self.retries += 1
+                txs.append(self._last_tx)
+            try:
+                for v in proxy.submit_tx_batch(
+                    txs, client_id=burst[0]["client_id"],
+                ):
+                    self.note(v)
+                self._last_tx = burst[0]["tx"]
+            except SubmitRejected as e:
+                if e.verdict == "shed":
+                    self.verdicts["shed"] += len(txs)
+                else:
+                    errors += 1
+            next_at += self.next_gap()
+        out = self.stats()
+        out["errors"] = errors
+        out["duration"] = clock.monotonic() - start
+        return out

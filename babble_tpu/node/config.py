@@ -94,6 +94,26 @@ class Config:
     slo_enabled: bool = True
     # submit->commit p99 objective threshold, Clock seconds
     slo_commit_p99: float = 30.0
+    # ---- ingress pipeline (ISSUE 16, babble_tpu/ingress/) ------------
+    # byte threshold at which the open ingress batch ships to the node's
+    # tx worker; an individual tx at/over this size bypasses coalescing
+    ingress_batch_bytes: int = 65536
+    # Clock seconds a partial ingress batch may be held waiting for more
+    # submissions. 0.0 = release on every pump (no hold) — the safe
+    # default for latency and the setting under which batched and
+    # single-tx submission commit byte-identical digests.
+    ingress_batch_deadline: float = 0.0
+    # bound on transactions held inside the ingress pipeline (queued +
+    # open batch); past it submissions get the `shed` verdict. 0 =
+    # unbounded (not recommended outside tests).
+    ingress_queue_cap: int = 8192
+    # per-client token-bucket rate, tx/s (client = peer addr or the
+    # app-supplied client_id). 0.0 = no per-client limit; > 0 enables
+    # the deficit-round-robin fairness scheduler between clients.
+    ingress_client_rate: float = 0.0
+    # trace_id LRU window within which a client retry of the same tx
+    # bytes is answered `accepted` without re-entering the pool
+    ingress_dedup_window: int = 65536
     # minimum seconds between Node.log_stats() snapshot lines — the
     # heartbeat fires every successful gossip exchange, which at test
     # heartbeats would be hundreds of log records a second

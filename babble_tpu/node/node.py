@@ -25,6 +25,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..hashgraph import Block, Store, WireEvent
+from ..ingress import IngressPipeline
 from ..obs import DEFAULT_COUNT_BUCKETS, Observability, SLOEngine
 from ..obs.tracectx import trace_id_for
 from ..net import (
@@ -130,6 +131,23 @@ class Node(NodeStateMachine):
         # stage then includes the queue wait (ISSUE 5)
         proxy.bind_obs(self.obs)
         self.submit_ch = proxy.submit_ch()
+        # ingress pipeline (ISSUE 16): every proxy submit entry point now
+        # routes through admission control + batching before the submit
+        # channel; downstream batches (lists) are drained by the tx
+        # worker via _add_transactions. Deadline pumping rides the
+        # heartbeat tick below (SimCluster._tick in the sim).
+        self.ingress = IngressPipeline(
+            downstream=self.submit_ch.put,
+            clock=conf.clock,
+            obs=self.obs,
+            batch_bytes=getattr(conf, "ingress_batch_bytes", 65536),
+            batch_deadline=getattr(conf, "ingress_batch_deadline", 0.0),
+            queue_cap=getattr(conf, "ingress_queue_cap", 8192),
+            client_rate=getattr(conf, "ingress_client_rate", 0.0),
+            dedup_window=getattr(conf, "ingress_dedup_window", 65536),
+            logger=conf.logger,
+        )
+        proxy.bind_ingress(self.ingress)
         self.shutdown_event = threading.Event()
         self.control_timer = new_random_control_timer(
             conf.heartbeat_timeout, rng=conf.rng, clock=conf.clock
@@ -151,6 +169,11 @@ class Node(NodeStateMachine):
         self.fast_forward_bounces = 0
         # unguarded-ok: same single-writer loop state as above
         self._consecutive_bounces = 0
+        # bouncing this many times in a row (no successful fast-forward,
+        # no successful exchange in between) licenses an own-chain rewind
+        # even without _rewind_ok, provided the exported-bound evidence
+        # still holds — see fast_forward
+        self._bounce_rewind_after = 3
         # unguarded-ok: same single-writer loop state as above
         self._missing_parent_syncs = 0
         # unguarded-ok: same single-writer loop state as above
@@ -299,6 +322,10 @@ class Node(NodeStateMachine):
             pending_fn=lambda: (
                 len(self.core.get_undetermined_events())
                 + len(self.core.transaction_pool)
+                # txs held inside the ingress pipeline are pending work
+                # too: a stall with a full ingress queue must not read
+                # as an idle node
+                + self.ingress.pending()
             ),
         )
 
@@ -356,6 +383,16 @@ class Node(NodeStateMachine):
                 threshold=float(max(1, conf.dispatch_queue_depth)) + 0.5,
                 description="the dispatch queue is not pinned past its "
                             "configured depth",
+            )
+            self.slo.objective(
+                "ingress_queue_depth",
+                series="babble_ingress_queue_depth",
+                kind="below",
+                threshold=float(
+                    max(1, getattr(conf, "ingress_queue_cap", 8192))
+                ) + 0.5,
+                description="the ingress pipeline is not pinned at its "
+                            "admission queue cap",
             )
             self.slo.objective(
                 "catchup_replay",
@@ -448,7 +485,13 @@ class Node(NodeStateMachine):
 
                 self.go_func(handle, name=f"node-{self.id}-rpc")
             elif tag == "tx":
-                self._add_transaction(item)
+                # the ingress pipeline emits BATCHES (lists) onto the
+                # submit channel; pre-pipeline producers still put single
+                # tx bytes — both are handled, one core_lock pass each
+                if isinstance(item, list):
+                    self._add_transactions(item)
+                else:
+                    self._add_transactions([item])
                 if not self.control_timer.set:
                     self.control_timer.reset()
             elif tag == "block":
@@ -473,6 +516,9 @@ class Node(NodeStateMachine):
             self.watchdog.check()
             if self.slo is not None:
                 self.slo.evaluate()
+            # deadline pump: ship a partial ingress batch whose hold
+            # deadline elapsed even when no new submission arrives
+            self.ingress.tick()
             if gossip:
                 # At most ONE outbound exchange in flight (deliberate
                 # deviation from the reference, node.go:180-196, which
@@ -772,6 +818,9 @@ class Node(NodeStateMachine):
         self._missing_parent_syncs = 0
         self._missing_parent_threshold = 3
         self._rewind_ok = False  # a full exchange worked: store is servable
+        # a completed exchange ends any bounce streak: only an UNBROKEN
+        # run of guard refusals may license the evidence-gated rewind
+        self._consecutive_bounces = 0
         with self.selector_lock:
             self.peer_selector.update_last(peer_addr)
         self.log_stats()
@@ -872,13 +921,33 @@ class Node(NodeStateMachine):
                 # recovery when one is unreachable).
                 with self._export_lock:
                     exported_bound = self._last_exported_seq
-                if self._rewind_ok and exported_bound <= my_frame_idx:
+                # The flag is not the only admissible license: the
+                # SyncLimit flip (see _gossip) does not set _rewind_ok —
+                # the store is servable, the node is merely too far
+                # behind to sync incrementally. If such a node holds one
+                # unexported own event above the frame, it wedges: every
+                # pull answers sync-limit, every fast-forward bounces
+                # here, forever (observed: 1268 consecutive bounces at a
+                # frozen block). Persistent bouncing with the evidence
+                # check passing IS the distinguishing signal — a node
+                # that flipped transiently either bounces on the anchor
+                # guard above or has exported its tail (pushing diffs is
+                # exporting), so its bound sits above the frame.
+                licensed = (
+                    self._rewind_ok
+                    or self._consecutive_bounces >= self._bounce_rewind_after
+                )
+                if licensed and exported_bound <= my_frame_idx:
                     self.logger.warning(
                         "fast_forward: accepting own-chain rewind (seq %d"
-                        " > frame %d) — store is unservable and nothing "
-                        "above own index %d was ever exported; discarding"
-                        " the tail is the only recovery",
-                        self.core.seq, my_frame_idx, exported_bound,
+                        " > frame %d; license: %s) — nothing above own "
+                        "index %d was ever exported; discarding the tail"
+                        " is the only recovery",
+                        self.core.seq, my_frame_idx,
+                        "unservable store" if self._rewind_ok
+                        else "%d consecutive bounces"
+                        % self._consecutive_bounces,
+                        exported_bound,
                     )
                 else:
                     self._count_bounce(
@@ -1025,17 +1094,27 @@ class Node(NodeStateMachine):
         self.obs.traces.mark_commit(block.transactions())
 
     def _add_transaction(self, tx: bytes) -> None:
-        tx = bytes(tx)
+        self._add_transactions([bytes(tx)])
+
+    def _add_transactions(self, txs) -> None:
+        """Insert an ingress batch into the pool: one timestamp pass, one
+        trace pass, ONE core_lock acquisition for the whole batch — the
+        amortization the ingress pipeline exists to buy."""
+        txs = [bytes(tx) for tx in txs]
+        now = self.clock.monotonic()
         with self._tx_times_lock:
-            if len(self._tx_times) < self._tx_times_cap:
+            for tx in txs:
+                if len(self._tx_times) >= self._tx_times_cap:
+                    break
                 # setdefault: re-submitting identical bytes keeps the
                 # FIRST submit time (latency must not shrink on retries)
-                self._tx_times.setdefault(tx, self.clock.monotonic())
-        # open the causal trace if the proxy hasn't already (bind_obs):
+                self._tx_times.setdefault(tx, now)
+        # open the causal traces if the proxy hasn't already (bind_obs):
         # idempotent, keeps the earliest submit mark
-        self.obs.traces.begin(tx)
+        for tx in txs:
+            self.obs.traces.begin(tx)
         with self.core_lock:
-            self.core.add_transactions([tx])
+            self.core.add_transactions(txs)
 
     def shutdown(self) -> None:
         if self.get_state() == NodeState.SHUTDOWN:
@@ -1112,6 +1191,9 @@ class Node(NodeStateMachine):
             # rewind-guard bounces out of CatchingUp (ADVICE r3): a stuck
             # catch-up ping-pong shows up here instead of hiding at debug
             "fast_forward_bounces": str(self.fast_forward_bounces),
+            # ingress pipeline (ISSUE 16): txs held pre-pool (queued for a
+            # token refill or coalescing in the open batch)
+            "ingress_pending": str(self.ingress.pending()),
             **self._live_engine_stats(),
             **self._mesh_stats(),
         }

@@ -1,7 +1,8 @@
+from ..ingress import IngressVerdict, SubmitRejected
 from .proxy import AppProxy, ProxyHandler
 from .inmem_proxy import InmemAppProxy
 from .dummy import InmemDummyClient, State
-from .jsonrpc import JSONRPCClient, JSONRPCError, JSONRPCServer
+from .jsonrpc import JSONRPCClient, JSONRPCError, JSONRPCServer, current_peer
 from .socket_app import SocketAppProxy
 from .socket_babble import DummySocketClient, SocketBabbleProxy
 
@@ -10,11 +11,14 @@ __all__ = [
     "ProxyHandler",
     "InmemAppProxy",
     "InmemDummyClient",
+    "IngressVerdict",
     "State",
+    "SubmitRejected",
     "JSONRPCClient",
     "JSONRPCError",
     "JSONRPCServer",
     "SocketAppProxy",
     "SocketBabbleProxy",
     "DummySocketClient",
+    "current_peer",
 ]

@@ -4,7 +4,7 @@
 from __future__ import annotations
 
 import queue
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from ..hashgraph import Block
 from .proxy import AppProxy, ProxyHandler
@@ -16,11 +16,26 @@ class InmemAppProxy(AppProxy):
         self._submit: "queue.Queue[bytes]" = queue.Queue()
         self._commit_handler: Optional[Callable[[Block], bytes]] = None
 
-    def submit_tx(self, tx: bytes) -> None:
+    def submit_tx(self, tx: bytes, client_id: str = "inmem"):
         # defensive copy: the caller may mutate its buffer after submit
         tx = bytes(tx)
         self._trace_submit(tx)
+        if self._ingress is not None:
+            return self._ingress.submit(tx, client_id=client_id)
         self._submit.put(tx)
+        return None
+
+    def submit_tx_batch(self, txs: List[bytes], client_id: str = "inmem"):
+        """Batch submit: one admission pass, per-tx verdicts (the in-mem
+        mirror of `Babble.SubmitTxBatch`)."""
+        txs = [bytes(tx) for tx in txs]
+        for tx in txs:
+            self._trace_submit(tx)
+        if self._ingress is not None:
+            return self._ingress.submit_batch(txs, client_id=client_id)
+        for tx in txs:
+            self._submit.put(tx)
+        return None
 
     def submit_ch(self) -> "queue.Queue[bytes]":
         return self._submit

@@ -22,6 +22,19 @@ class JSONRPCError(Exception):
     pass
 
 
+# per-connection peer identity: each accepted connection gets its own
+# server thread, so a thread-local set before the dispatch loop lets
+# handlers (e.g. ingress admission) attribute calls to a client without
+# widening every handler signature
+_conn_local = threading.local()
+
+
+def current_peer() -> str:
+    """Peer address ("host:port") of the connection whose request the
+    calling handler thread is serving; "" outside a handler."""
+    return getattr(_conn_local, "peer", "")
+
+
 # one request/response line: block commits and app snapshots ride these,
 # so generous — but bounded, like the gossip transport's frame cap
 # (net/tcp_transport.py DEFAULT_MAX_FRAME)
@@ -216,6 +229,11 @@ class JSONRPCServer:
 
     def _serve_conn(self, sock: socket.socket) -> None:
         try:
+            try:
+                peer = "%s:%s" % sock.getpeername()[:2]
+            except OSError:
+                peer = ""
+            _conn_local.peer = peer
             sock.settimeout(self.idle_timeout)
             rfile = sock.makefile("rb")
             while not self._shutdown.is_set():

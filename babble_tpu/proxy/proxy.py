@@ -17,6 +17,16 @@ from ..hashgraph import Block
 class AppProxy(ABC):
     # observability bundle bound by the owning Node; None until bound
     _obs = None
+    # IngressPipeline bound by the owning Node; None until bound — when
+    # bound, submit entry points route through it (admission verdicts,
+    # batching) instead of putting straight onto submit_ch
+    _ingress = None
+
+    def bind_ingress(self, pipeline) -> None:
+        """Attach the node's IngressPipeline. Submissions arriving after
+        this point get explicit accepted/queued/shed verdicts and
+        coalesce into batches before the submit channel."""
+        self._ingress = pipeline
 
     def bind_obs(self, obs) -> None:
         """Attach the node's observability bundle so transaction

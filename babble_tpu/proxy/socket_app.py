@@ -18,7 +18,7 @@ from typing import Optional
 from ..common import Clock, SYSTEM_CLOCK
 from ..hashgraph import Block
 from ..utils.codec import b64d, b64e
-from .jsonrpc import JSONRPCClient, JSONRPCServer
+from .jsonrpc import JSONRPCClient, JSONRPCServer, current_peer
 from .proxy import AppProxy
 
 
@@ -36,17 +36,50 @@ class SocketAppProxy(AppProxy):
         self.client = JSONRPCClient(client_addr, timeout=timeout, clock=clock)
         self.server = JSONRPCServer(bind_addr)
         self.server.register("Babble.SubmitTx", self._handle_submit_tx)
+        self.server.register("Babble.SubmitTxBatch", self._handle_submit_tx_batch)
         self.server.start()
 
     @property
     def bind_addr(self) -> str:
         return self.server.addr
 
-    def _handle_submit_tx(self, param) -> bool:
-        tx = b64d(param)
+    def _client_id(self, supplied) -> str:
+        """Admission identity: the app-supplied client_id wins (a proxy
+        fronting many users can pass theirs through); otherwise the TCP
+        peer address of the connection serving this request."""
+        if supplied:
+            return str(supplied)
+        return current_peer() or "rpc"
+
+    def _handle_submit_tx(self, param):
+        # wire forms: bare b64 tx (legacy) or {"tx": b64, "client_id"?}
+        if isinstance(param, dict):
+            tx = b64d(param.get("tx", ""))
+            cid = self._client_id(param.get("client_id"))
+        else:
+            tx = b64d(param)
+            cid = self._client_id(None)
         self._trace_submit(tx)
+        if self._ingress is not None:
+            return self._ingress.submit(tx, client_id=cid).to_wire()
         self._submit_ch.put(tx)
         return True
+
+    def _handle_submit_tx_batch(self, param):
+        if not isinstance(param, dict) or not isinstance(param.get("txs"), list):
+            raise ValueError('SubmitTxBatch wants {"txs": [b64,...], "client_id"?}')
+        txs = [b64d(t) for t in param["txs"]]
+        cid = self._client_id(param.get("client_id"))
+        for tx in txs:
+            self._trace_submit(tx)
+        if self._ingress is not None:
+            return [
+                v.to_wire()
+                for v in self._ingress.submit_batch(txs, client_id=cid)
+            ]
+        for tx in txs:
+            self._submit_ch.put(tx)
+        return [{"verdict": "accepted", "reason": "legacy"} for _ in txs]
 
     # ---- AppProxy interface -------------------------------------------
 

@@ -11,12 +11,18 @@ The application holds a SocketBabbleProxy:
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import List, Optional
 
 from ..common import Clock, SYSTEM_CLOCK
 from ..hashgraph import Block
+from ..ingress import (
+    IngressVerdict,
+    SubmitRejected,
+    VERDICT_SHED,
+    verdict_from_wire,
+)
 from ..utils.codec import b64d, b64e
-from .jsonrpc import JSONRPCClient, JSONRPCServer
+from .jsonrpc import JSONRPCClient, JSONRPCError, JSONRPCServer
 from .proxy import ProxyHandler
 
 
@@ -57,10 +63,50 @@ class SocketBabbleProxy:
 
     # ---- client (app -> node) -----------------------------------------
 
-    def submit_tx(self, tx: bytes) -> None:
-        ok = self.client.call("Babble.SubmitTx", b64e(tx))
-        if not ok:
-            raise RuntimeError("SubmitTx rejected")
+    def submit_tx(
+        self, tx: bytes, client_id: Optional[str] = None
+    ) -> IngressVerdict:
+        """Submit one transaction. Returns the server's admission verdict
+        (accepted/queued — both mean the tx is in); raises SubmitRejected
+        with verdict="shed" when the server applied backpressure, or
+        verdict="error" on transport/server failure."""
+        param = (
+            {"tx": b64e(tx), "client_id": client_id}
+            if client_id is not None
+            else b64e(tx)
+        )
+        try:
+            res = self.client.call("Babble.SubmitTx", param)
+        except JSONRPCError as exc:
+            raise SubmitRejected("error", str(exc)) from exc
+        verdict = verdict_from_wire(res)
+        if verdict.verdict == VERDICT_SHED:
+            raise SubmitRejected(
+                "shed", verdict.reason or "shed", server_verdict=verdict
+            )
+        return verdict
+
+    def submit_tx_batch(
+        self, txs: List[bytes], client_id: Optional[str] = None
+    ) -> List[IngressVerdict]:
+        """Submit a client batch over one `Babble.SubmitTxBatch` call.
+        Returns one verdict per tx IN ORDER (shed verdicts included —
+        per-tx backpressure inside a batch is data, not an exception);
+        raises SubmitRejected("error", ...) when the call itself failed
+        or the server's answer is malformed."""
+        param = {"txs": [b64e(tx) for tx in txs]}
+        if client_id is not None:
+            param["client_id"] = client_id
+        try:
+            res = self.client.call("Babble.SubmitTxBatch", param)
+        except JSONRPCError as exc:
+            raise SubmitRejected("error", str(exc)) from exc
+        if not isinstance(res, list) or len(res) != len(txs):
+            raise SubmitRejected(
+                "error",
+                f"SubmitTxBatch: want {len(txs)} verdicts, got {res!r}",
+            )
+        return [verdict_from_wire(v) for v in res]
 
     def close(self) -> None:
         self.client.close()

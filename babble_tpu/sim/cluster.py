@@ -105,6 +105,11 @@ class SimCluster:
         dispatch_batch_deadline: float = 0.0,
         dispatch_batch_rows: int = 64,
         mesh_validator_shards: int = 1,
+        ingress_batch_bytes: int = 65536,
+        ingress_batch_deadline: float = 0.0,
+        ingress_queue_cap: int = 8192,
+        ingress_client_rate: float = 0.0,
+        ingress_dedup_window: int = 65536,
         heartbeat: float = 0.05,
         tcp_timeout: float = 1.0,
         sync_limit: int = 300,
@@ -140,6 +145,11 @@ class SimCluster:
         self.dispatch_batch_deadline = dispatch_batch_deadline
         self.dispatch_batch_rows = dispatch_batch_rows
         self.mesh_validator_shards = mesh_validator_shards
+        self.ingress_batch_bytes = ingress_batch_bytes
+        self.ingress_batch_deadline = ingress_batch_deadline
+        self.ingress_queue_cap = ingress_queue_cap
+        self.ingress_client_rate = ingress_client_rate
+        self.ingress_dedup_window = ingress_dedup_window
         self.heartbeat = heartbeat
         self.tcp_timeout = tcp_timeout
         self.sync_limit = sync_limit
@@ -206,6 +216,11 @@ class SimCluster:
             dispatch_batch_deadline=self.dispatch_batch_deadline,
             dispatch_batch_rows=self.dispatch_batch_rows,
             mesh_validator_shards=self.mesh_validator_shards,
+            ingress_batch_bytes=self.ingress_batch_bytes,
+            ingress_batch_deadline=self.ingress_batch_deadline,
+            ingress_queue_cap=self.ingress_queue_cap,
+            ingress_client_rate=self.ingress_client_rate,
+            ingress_dedup_window=self.ingress_dedup_window,
             clock=self.clock,
             rng=sn.rng,
             logger=self.logger,
@@ -250,10 +265,16 @@ class SimCluster:
         node = sn.node
         while True:
             try:
-                tx = node.submit_ch.get_nowait()
+                item = node.submit_ch.get_nowait()
             except queue.Empty:
                 break
-            node._add_transaction(tx)
+            # the ingress pipeline emits batches (lists); pre-pipeline
+            # producers put single tx bytes — same contract as the
+            # threaded _serve_source
+            if isinstance(item, list):
+                node._add_transactions(item)
+            else:
+                node._add_transaction(item)
         while True:
             try:
                 block = node.commit_ch.get_nowait()
@@ -287,6 +308,11 @@ class SimCluster:
         node.watchdog.check()
         if node.slo is not None:
             node.slo.evaluate()
+        # deadline pump for the ingress pipeline, exactly like the
+        # threaded _babble tick: a held partial batch releases on the
+        # heartbeat once its deadline elapses on virtual time
+        node.ingress.tick()
+        self._drain(sn)
         state = node.get_state()
         extra = 0.0
         if state == NodeState.CATCHING_UP:
@@ -633,6 +659,7 @@ class SimCluster:
             "commit_latency": self.latency_histograms(),
             "stage_latency": self.stage_histograms(),
             "mesh_dispatch": self.dispatch_histograms(),
+            "ingress": self.ingress_counters(),
             "trace_fingerprint": self.trace_fingerprint(),
             "flightrec_fingerprint": self.flightrec_fingerprint(),
             "provenance_fingerprint": self.provenance_fingerprint(),
@@ -675,6 +702,27 @@ class SimCluster:
                 continue
             snap = sn.node.obs.registry.snapshot()
             out[sn.name] = {k: snap.get(k) for k in self.DISPATCH_HISTOGRAMS}
+        return out
+
+    INGRESS_SERIES = (
+        "babble_ingress_verdicts_total",
+        "babble_ingress_shed_total",
+        "babble_ingress_dedup_hits_total",
+        "babble_ingress_batch_txs",
+    )
+
+    def ingress_counters(self) -> Dict[str, Any]:
+        """Per-live-node snapshots of the ingress admission series
+        (verdicts, sheds by reason, dedup hits, batch-size histogram).
+        Admission decisions are pure functions of the seeded workload and
+        virtual time, so same-seed runs must produce byte-identical
+        snapshots — the ingress entry in the determinism contract."""
+        out: Dict[str, Any] = {}
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            snap = sn.node.obs.registry.snapshot()
+            out[sn.name] = {k: snap.get(k) for k in self.INGRESS_SERIES}
         return out
 
     STAGE_HISTOGRAMS = (

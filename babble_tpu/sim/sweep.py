@@ -42,6 +42,11 @@ def run_one(
     dispatch_batch_deadline: float = 0.0,
     dispatch_batch_rows: int = 64,
     mesh_validator_shards: int = 1,
+    ingress_batch_bytes: int = 65536,
+    ingress_batch_deadline: float = 0.0,
+    ingress_queue_cap: int = 8192,
+    ingress_client_rate: float = 0.0,
+    ingress_dedup_window: int = 65536,
     until: Optional[float] = 30.0,
     target_block: Optional[int] = None,
     artifact_dir: str = "docs/artifacts",
@@ -73,6 +78,11 @@ def run_one(
         dispatch_batch_deadline=dispatch_batch_deadline,
         dispatch_batch_rows=dispatch_batch_rows,
         mesh_validator_shards=mesh_validator_shards,
+        ingress_batch_bytes=ingress_batch_bytes,
+        ingress_batch_deadline=ingress_batch_deadline,
+        ingress_queue_cap=ingress_queue_cap,
+        ingress_client_rate=ingress_client_rate,
+        ingress_dedup_window=ingress_dedup_window,
         store_dir=store_dir,
         artifact_dir=artifact_dir,
         heartbeat=heartbeat,

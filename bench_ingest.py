@@ -1,0 +1,277 @@
+"""Ingest benchmark of record (ISSUE 16): sustained offered-load
+throughput and submit->commit latency through the ingress pipeline
+(`babble_tpu/ingress/`), gated by the SLOEngine on declared p50/p99
+objectives.
+
+The workload is the open-loop generator (`ingress/loadgen.py`): Poisson
+arrivals at a fixed offered rate from a 10^5-client id space, driven
+over the deterministic sim fabric on virtual time — so the numbers are
+reproducible from the seed and coordinated omission cannot hide
+queueing (the generator never slows down because the system queued).
+Latency comes from the same `babble_commit_latency_seconds` histograms
+production nodes expose, merged across the cluster; each node's last
+commit exemplar (PR 11) rides in the headline so a p99 breach links to
+a concrete trace_id.
+
+Two runs per invocation:
+
+1. the measured run — submissions through `submit_tx_batch` (the
+   pipeline path), with periodic client retries exercising the dedup
+   window;
+2. the control run — the SAME seeded workload submitted single-tx,
+   bypassing the pipeline. The two clusters' commit digests must be
+   byte-identical: batching, dedup and fairness may reshape HOW txs
+   enter, never WHAT is committed.
+
+Prints the headline as the LAST stdout line:
+  {"metric": ..., "value": committed tx/s, "unit": "tx/s",
+   "p50_s": ..., "p99_s": ..., "offered": N, "committed": N,
+   "clients": N, "verdicts": {...}, "ingress": {...},
+   "digest_match": true, "metrics": {...}}
+
+`--slo` turns the latency trajectory into a gate: the p50/p99 estimates
+are declared as SLO objectives and the process exits nonzero on breach
+or on a digest mismatch. The SLO report goes to stderr so the headline
+stays the last stdout line. `--smoke` shrinks the horizon for CI.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_CLIENTS = 100_000
+OFFERED_RATE = 400.0  # tx/s over virtual time
+BURST = 4
+UNTIL = 10.0  # virtual seconds
+RETRY_EVERY = 16  # every Nth burst re-offers a tx (dedup exercise)
+SEED = 0
+TARGET_P50_S = 5.0
+TARGET_P99_S = 15.0
+
+
+def run_cluster(seed, via, rate, clients, burst, until, retry_every):
+    """One seeded cluster + loadgen run. Returns (cluster, gen, result)."""
+    from babble_tpu.ingress import OpenLoopLoadGen
+    from babble_tpu.sim import SimCluster
+
+    cluster = SimCluster(
+        n=4,
+        seed=seed,
+        heartbeat=0.05,
+        # deadline 0: release on every pump — the setting under which
+        # batched and single-tx submission commit identical digests
+        ingress_batch_deadline=0.0,
+        ingress_queue_cap=8192,
+    )
+    gen = OpenLoopLoadGen(
+        rate=rate, clients=clients, burst=burst,
+        retry_every=retry_every if via == "ingress" else retry_every,
+        seed=seed,
+    )
+    gen.drive_sim(cluster, until=until, via=via)
+    res = cluster.run(until=until, inject=False)
+    return cluster, gen, res
+
+
+def merge_latency(snapshots):
+    """Merge per-node commit-latency histogram snapshots (same bucket
+    bounds) into one (count, buckets, exemplar) triple."""
+    count, sums = 0, {}
+    exemplar = None
+    order = []
+    for snap in snapshots:
+        if not snap:
+            continue
+        entry = snap.get("series", {}).get("")
+        if not entry:
+            continue
+        count += entry["count"]
+        exemplar = entry.get("exemplar", exemplar)
+        for le, cum in entry["buckets"]:
+            if le not in sums:
+                sums[le] = 0
+                order.append(le)
+            sums[le] += cum
+    return count, [(le, sums[le]) for le in order], exemplar
+
+
+def quantile_le(count, buckets, q):
+    """Conservative quantile estimate from cumulative buckets: the
+    smallest bucket bound covering >= q of observations (inf when the
+    quantile sits past the last bound)."""
+    if count <= 0:
+        return float("inf")
+    need = math.ceil(q * count)
+    for le, cum in buckets:
+        if cum >= need:
+            return float(le)
+    return float("inf")
+
+
+def sum_counter(per_node, series):
+    """Sum one counter series' labeled values across the per-node
+    ingress snapshots SimCluster.result() carries."""
+    out = {}
+    for snaps in per_node.values():
+        snap = (snaps or {}).get(series)
+        if not snap:
+            continue
+        for label, value in snap.get("series", {}).items():
+            out[label] = out.get(label, 0) + value
+    return out
+
+
+def slo_gate(obs, p50_max, p99_max):
+    """Declare the latency objectives over the bench registry and
+    evaluate once (cumulative single-sample evaluation, like bench.py's
+    throughput gate). Returns (ok, status_doc)."""
+    from babble_tpu.obs import SLOEngine
+
+    slo = SLOEngine(obs)
+    slo.objective(
+        "ingest_p50",
+        series="babble_ingest_p50_seconds",
+        kind="below", threshold=p50_max,
+        description="median submit->commit latency under offered load",
+    )
+    slo.objective(
+        "ingest_p99",
+        series="babble_ingest_p99_seconds",
+        kind="below", threshold=p99_max,
+        description="p99 submit->commit latency under offered load",
+    )
+    status = slo.evaluate()
+    return not slo.breached(), status
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slo", action="store_true",
+                    help="Gate the run: exit 1 when p50/p99 breach the "
+                         "declared objectives or the batched-vs-single "
+                         "digests mismatch")
+    ap.add_argument("--smoke", action="store_true",
+                    help="Short CI horizon (fewer virtual seconds, lower "
+                         "offered rate; same 10^5-client id space)")
+    ap.add_argument("--seed", type=int, default=SEED)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="Offered load in tx/s over virtual time")
+    ap.add_argument("--until", type=float, default=None,
+                    help="Virtual-time horizon in seconds")
+    ap.add_argument("--clients", type=int, default=N_CLIENTS,
+                    help="Simulated client id space")
+    ap.add_argument("--slo-p50", type=float, default=TARGET_P50_S)
+    ap.add_argument("--slo-p99", type=float, default=TARGET_P99_S)
+    ap.add_argument("--no-digest-check", action="store_true",
+                    help="Skip the single-tx control run")
+    args = ap.parse_args(argv)
+
+    rate = args.rate if args.rate is not None else (
+        120.0 if args.smoke else OFFERED_RATE
+    )
+    until = args.until if args.until is not None else (
+        3.0 if args.smoke else UNTIL
+    )
+
+    cluster, gen, res = run_cluster(
+        args.seed, "ingress", rate, args.clients, BURST, until, RETRY_EVERY,
+    )
+
+    committed = max(
+        sn.node.core.get_consensus_transactions_count()
+        for sn in cluster.sns if not sn.crashed
+    )
+    vtime = res["virtual_time"] or 1.0
+    tx_per_sec = committed / vtime
+    count, buckets, exemplar = merge_latency(
+        list(res["commit_latency"].values())
+    )
+    p50 = quantile_le(count, buckets, 0.50)
+    p99 = quantile_le(count, buckets, 0.99)
+    verdicts = sum_counter(res["ingress"], "babble_ingress_verdicts_total")
+    sheds = sum_counter(res["ingress"], "babble_ingress_shed_total")
+    dedups = sum_counter(
+        res["ingress"], "babble_ingress_dedup_hits_total"
+    ).get("", 0)
+
+    digest_match = None
+    if not args.no_digest_check:
+        # control run: identical seeded workload, single-tx, no pipeline
+        _, _, res_direct = run_cluster(
+            args.seed, "direct", rate, args.clients, BURST, until,
+            RETRY_EVERY,
+        )
+        digest_match = res["digest"] == res_direct["digest"]
+
+    # bench-local registry: the obs-layer view the SLO gate runs over
+    from babble_tpu.obs import Observability
+
+    obs = Observability()
+    obs.gauge(
+        "babble_ingest_tx_per_second",
+        "Ingest benchmark committed-transaction throughput",
+    ).set(tx_per_sec)
+    obs.gauge(
+        "babble_ingest_p50_seconds",
+        "Ingest benchmark submit->commit p50 estimate",
+    ).set(p50)
+    obs.gauge(
+        "babble_ingest_p99_seconds",
+        "Ingest benchmark submit->commit p99 estimate",
+    ).set(p99)
+
+    headline = {
+        "metric": (
+            f"txs committed/sec under {rate:.0f} tx/s open-loop offered "
+            f"load, {args.clients} clients, 4 nodes, sim fabric"
+        ),
+        "value": round(tx_per_sec, 1),
+        "unit": "tx/s",
+        "offered": gen.offered,
+        "committed": committed,
+        "clients": args.clients,
+        "p50_s": None if p50 == float("inf") else p50,
+        "p99_s": None if p99 == float("inf") else p99,
+        "latency_samples": count,
+        "exemplar": exemplar,
+        "verdicts": verdicts,
+        "sheds": sheds,
+        "dedup_hits": dedups,
+        "retries_offered": gen.retries,
+        "digest_match": digest_match,
+        "virtual_time": vtime,
+        "metrics": obs.registry.snapshot(),
+    }
+    print(json.dumps(headline))
+
+    rc = 0
+    if digest_match is False:
+        print(
+            "DIGEST MISMATCH: batched and single-tx submission committed "
+            "different blocks",
+            file=sys.stderr,
+        )
+        rc = 1
+    if args.slo:
+        ok, status = slo_gate(obs, args.slo_p50, args.slo_p99)
+        print(
+            "SLO gate:",
+            json.dumps(status["objectives"], sort_keys=True),
+            file=sys.stderr,
+        )
+        if not ok:
+            print(
+                f"SLO BREACH: p50={p50}s p99={p99}s over the "
+                f"({args.slo_p50}s, {args.slo_p99}s) objectives",
+                file=sys.stderr,
+            )
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

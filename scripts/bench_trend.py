@@ -14,6 +14,9 @@ Artifact shapes handled (oldest rounds predate the structured headline):
 - MULTICHIP_r*.json: {"rc", "ok", "tail"} — blocked ms/call from the
   JSON headline (unit "ms/call") once it exists, else regexes over the
   human OK line ("device-blocked N ms/call", then "device N ms/call").
+- BENCH_INGEST_r*.json: same shape as BENCH; gated twice — committed
+  tx/s (higher is better) and submit->commit p99 seconds (lower is
+  better), both read from the bench_ingest.py headline.
 Rounds with rc != 0 or no extractable number are reported and skipped.
 """
 
@@ -85,6 +88,23 @@ def multichip_value(doc):
     return None
 
 
+def ingest_p99_value(doc):
+    """submit->commit p99 seconds of one BENCH_INGEST round, or None.
+    The ingest headline carries the latency estimate alongside the
+    throughput value; a missing/None p99 (no commits) is unextractable."""
+    if doc.get("rc") != 0:
+        return None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(
+        parsed.get("p99_s"), (int, float)
+    ):
+        return float(parsed["p99_s"])
+    headline = _last_json_line(doc.get("tail"))
+    if headline and isinstance(headline.get("p99_s"), (int, float)):
+        return float(headline["p99_s"])
+    return None
+
+
 def load_series(pattern, extract):
     """[(round, value-or-None)] sorted by round, one entry per artifact."""
     series = []
@@ -144,6 +164,14 @@ def main():
         (
             "mesh scale throughput", "BENCH_MESH_r*.json", bench_value,
             "events/s", max,
+        ),
+        (
+            "ingest throughput", "BENCH_INGEST_r*.json", bench_value,
+            "tx/s", max,
+        ),
+        (
+            "ingest submit->commit p99", "BENCH_INGEST_r*.json",
+            ingest_p99_value, "s", min,
         ),
     )
     failed = [

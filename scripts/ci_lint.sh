@@ -33,6 +33,13 @@ python -m babble_tpu lint --races --race-seeds "${BABBLE_RACE_SEEDS:-5}" || rc=1
 echo "== babble-tpu bisector smoke (hard gate) =="
 python -m babble_tpu explain --smoke "${BABBLE_BISECT_SEEDS:-3}" || rc=1
 
+# Ingress pipeline smoke (hard gate, ISSUE 16): a short-horizon open-loop
+# run through the submit pipeline — SLO-gated p50/p99, shed/dedup counters,
+# and the batched-vs-single-tx digest-equality check. Deterministic from
+# the seed, a few seconds of wall clock.
+echo "== babble-tpu ingest smoke (hard gate) =="
+JAX_PLATFORMS=cpu python bench_ingest.py --smoke --slo || rc=1
+
 echo "== ruff (advisory) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"
