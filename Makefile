@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint race bench bench-mesh bench-ingest trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint race bench bench-mesh bench-ingest bench-packed trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -45,6 +45,17 @@ bench:
 # archived as BENCH_MESH_r*.json, gated by the trend series below
 bench-mesh:
 	$(PY) bench_mesh_scale.py --slo
+
+# bit-packed voting-table bench (ISSUE 17): the same validator sweep with
+# the packed discipline as the headline — wide-vs-packed byte-equality
+# gate per rung and the packed-speedup SLO floor from 1024 validators up
+# (the floor objective arms only when the sweep reaches --slo-packed-n;
+# the default CPU sweep stays under it because the WIDE baseline at 1024
+# already exhausts host memory on the 8-device virtual mesh — run
+# `--validators 64,256,1024` on real hardware to arm the crossover gate);
+# archived as BENCH_PACKED_r*.json, gated by the trend series below
+bench-packed:
+	$(PY) bench_mesh_scale.py --headline packed --validators 8,64,128 --slo
 
 # open-loop ingest bench (ISSUE 16): offered load through the ingress
 # pipeline on the sim fabric, gated on submit->commit p50/p99 and on

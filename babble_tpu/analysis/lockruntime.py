@@ -73,6 +73,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "babble_tpu.service",
     "babble_tpu.tpu.dispatch",
     "babble_tpu.tpu.live",
+    "babble_tpu.tpu.packed",
 )
 
 # module-level locks wrapped for lock-order coverage: their ordering vs

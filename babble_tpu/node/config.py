@@ -60,6 +60,13 @@ class Config:
     # over validators as well as rounds. Must divide mesh_devices; 1 =
     # the original rounds-only layout.
     mesh_validator_shards: int = 1
+    # voting-table layout (ISSUE 17, tpu/packed.py): "1" packs the
+    # validator axis of the strongly-seen/vote tables into uint32 lanes
+    # with popcount tallies (byte-equal results, ~8x smaller voting
+    # state), "0" keeps the wide bool layout, "auto" packs from
+    # tpu.packed.PACKED_AUTO_MIN_N validators up. The env var
+    # BABBLE_PACKED_VOTING overrides this at call time.
+    packed_voting: str = "auto"
     # time-source seam: every monotonic read and sleep in the node layer
     # goes through this Clock, so the deterministic simulator
     # (babble_tpu/sim/) can drive nodes on virtual time. Production uses

@@ -35,6 +35,7 @@ class Core:
         dispatch_batch_deadline: float = 0.0,
         dispatch_batch_rows: int = 64,
         mesh_validator_shards: int = 1,
+        packed_voting: str = "auto",
         obs=None,
     ):
         self.id = id_
@@ -72,6 +73,19 @@ class Core:
         # over validators as well as rounds
         self.dispatch_batch_rows = max(1, int(dispatch_batch_rows))
         self.mesh_validator_shards = max(1, int(mesh_validator_shards))
+        # voting-table layout knob (ISSUE 17): installed process-wide via
+        # tpu.packed.set_packed_mode so every engine rung — one-shot,
+        # doubling, sharded mesh, incremental live, queued dispatch —
+        # resolves the same layout. Validated here (not just at the CLI)
+        # because config files and embedding callers bypass argparse; the
+        # lazy import keeps CPU-backend nodes free of the jax import.
+        if str(packed_voting) not in ("0", "1", "auto"):
+            raise ValueError(f"unknown packed_voting mode: {packed_voting!r}")
+        self.packed_voting = str(packed_voting)
+        if consensus_backend == "tpu":
+            from ..tpu.packed import set_packed_mode
+
+            set_packed_mode(self.packed_voting)
         self._mesh = None  # built lazily on the first mesh-backend run
         self.device_consensus_runs = 0
         self.device_consensus_fallbacks = 0

@@ -116,6 +116,7 @@ class Node(NodeStateMachine):
             dispatch_batch_deadline=getattr(conf, "dispatch_batch_deadline", 0.0),
             dispatch_batch_rows=getattr(conf, "dispatch_batch_rows", 64),
             mesh_validator_shards=getattr(conf, "mesh_validator_shards", 1),
+            packed_voting=getattr(conf, "packed_voting", "auto"),
             obs=self.obs,
         )
         self.core_lock = threading.Lock()
@@ -1196,7 +1197,28 @@ class Node(NodeStateMachine):
             "ingress_pending": str(self.ingress.pending()),
             **self._live_engine_stats(),
             **self._mesh_stats(),
+            **self._table_bytes_stats(),
         }
+
+    def _table_bytes_stats(self):
+        """Voting-table footprint of the layout the device engine last ran
+        (ISSUE 17): snapshot adapter over the babble_device_table_bytes
+        gauge written by tpu.packed.observe_table_bytes at every engine
+        rung. Keys appear only once a device pass has actually run; both
+        layouts are reported if a node flipped mid-life (series persist),
+        so an operator can read the wide->packed reduction off /stats."""
+        gauge = self.obs.registry.get("babble_device_table_bytes")
+        if gauge is None:
+            return {}
+        out = {"packed_voting": getattr(self.core, "packed_voting", "auto")}
+        for layout in ("wide", "packed"):
+            total = sum(
+                gauge.value(table=t, layout=layout)
+                for t in ("strongly_seen", "votes")
+            )
+            if total:
+                out[f"device_table_bytes_{layout}"] = str(int(total))
+        return out
 
     def _mesh_stats(self):
         """Mesh product path (--mesh-devices): per-call staging vs device

@@ -61,19 +61,25 @@ class _AsyncPass:
     frontier r_cap retry) happen on this thread; the serve thread only
     blocks in result()."""
 
-    def __init__(self, mesh, grid, prefer_doubling: bool = False):
+    def __init__(self, mesh, grid, prefer_doubling: bool = False,
+                 packed=None):
         self.done = threading.Event()
         # unguarded-ok: Event handoff — _run's writes happen-before
         # done.set(), and result() reads only after done.wait()
         self.value = None
         # unguarded-ok: same Event handoff as value
         self.error: Optional[BaseException] = None
+        # layout resolved at DISPATCH time (tpu/packed.py), so a knob
+        # flip cannot split one queued pipeline across layouts
+        from .packed import resolve_packed
+
+        packed = resolve_packed(packed, grid.n)
         threading.Thread(
-            target=self._run, args=(mesh, grid, prefer_doubling),
+            target=self._run, args=(mesh, grid, prefer_doubling, packed),
             name="mesh-dispatch", daemon=True,
         ).start()
 
-    def _run(self, mesh, grid, prefer_doubling: bool) -> None:
+    def _run(self, mesh, grid, prefer_doubling: bool, packed: bool) -> None:
         try:
             from .doubling import use_doubling
             from .engine import _frontier_safe
@@ -92,14 +98,20 @@ class _AsyncPass:
                     # deep section: log-diameter cold path; anything its
                     # kernels cannot certify falls down the resident ladder
                     try:
-                        self.value = sharded_doubling_passes(mesh, grid)
+                        self.value = sharded_doubling_passes(
+                            mesh, grid, packed=packed
+                        )
                     except GridUnsupported:
                         self.value = None
                 if self.value is None:
                     if _frontier_safe(grid):
-                        self.value = sharded_frontier_passes(mesh, grid)
+                        self.value = sharded_frontier_passes(
+                            mesh, grid, packed=packed
+                        )
                     else:
-                        self.value = sharded_run_passes(mesh, grid)
+                        self.value = sharded_run_passes(
+                            mesh, grid, packed=packed
+                        )
         except BaseException as e:  # noqa: BLE001 — surfaced in result()
             self.error = e
         finally:
@@ -298,9 +310,13 @@ class MeshDispatchQueue:
             "device.dispatch", t0, dt,
             {"node": hg.obs.node_id, "batches": 1, "rows": delta_rows},
         )
+        from .packed import observe_table_bytes, resolve_packed
+
+        pk = resolve_packed(None, grid.n)
+        observe_table_bytes(hg.obs, grid.n, grid.r_max, pk)
         self.inflight.append(
             (
-                _AsyncPass(self.mesh, grid, prefer_doubling=batched),
+                _AsyncPass(self.mesh, grid, prefer_doubling=batched, packed=pk),
                 grid, topo_hi, clock.monotonic(),
             )
         )

@@ -76,6 +76,7 @@ from .engine import PassResults, _bucket, _frontier_safe, pad_grid, rebase_round
 from .frontier import build_inv, level_lamport
 from .grid import DagGrid, GridUnsupported, MAX_INT32, MIN_INT32
 from .kernels import _decide_fame, _decide_round_received
+from .packed import resolve_packed
 
 # ---------------------------------------------------------------------------
 # crossover selection (engine ladder)
@@ -393,13 +394,15 @@ def _doubling_walk(put, inv_i32, rows_by_d, fd_d, la_d, x0, s_np, first_nw,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("super_majority", "n_participants", "d_cap")
+    jax.jit,
+    static_argnames=("super_majority", "n_participants", "d_cap", "packed"),
 )
 def _fame_received(wtable, la, fd, index, creator, coin, rounds, last_round,
-                   super_majority: int, n_participants: int, d_cap: int):
+                   super_majority: int, n_participants: int, d_cap: int,
+                   packed: bool = False):
     fame = _decide_fame(
         wtable, la, fd, index, coin, last_round,
-        super_majority, n_participants, d_cap,
+        super_majority, n_participants, d_cap, packed=packed,
     )
     received = _decide_round_received(
         wtable, la, index, creator, rounds,
@@ -689,6 +692,7 @@ def _doubling_stage1(grid: DagGrid, put, stats: dict):
 
 def run_doubling_passes(
     grid: DagGrid, d_max: Optional[int] = None, stats: Optional[dict] = None,
+    packed: Optional[bool] = None,
 ) -> PassResults:
     """Full three-pass cold-path pipeline on the default device; same
     PassResults contract as run_passes/run_frontier_passes. Raises
@@ -708,6 +712,7 @@ def run_doubling_passes(
         jax.device_put(grid_p.index), jax.device_put(grid_p.creator),
         jax.device_put(grid_p.coin_bit), jax.device_put(rounds_p),
         jnp.int32(last_round), grid.super_majority, grid.n, d_cap,
+        packed=resolve_packed(packed, grid.n),
     )
     received = np.asarray(received_d)[:e_real]
     st["passes"] = st.get("closure_passes", 0) + st.get("walk_chunks", 0) + 1

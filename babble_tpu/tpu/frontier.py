@@ -340,7 +340,9 @@ frontier_rounds = functools.partial(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_cap", "d_cap"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_cap", "d_cap", "packed",
+    ),
 )
 def frontier_pipeline(
     inv_f32: jax.Array,  # (N, N, L) f32 from build_inv
@@ -356,6 +358,7 @@ def frontier_pipeline(
     n_participants: int,
     r_cap: int,
     d_cap: int = None,
+    packed: bool = False,
 ) -> PipelineResult:
     """DivideRounds (frontier walk) + DecideFame + DecideRoundReceived as
     one XLA program; same output contract as kernels.consensus_pipeline.
@@ -369,6 +372,7 @@ def frontier_pipeline(
         fr.witness_table, la, fd, index, coin_bit, fr.last_round,
         super_majority, n_participants,
         r_cap + 2 if d_cap is None else d_cap,
+        packed=packed,
     )
     received = _decide_round_received(
         fr.witness_table, la, index, creator, fr.rounds,

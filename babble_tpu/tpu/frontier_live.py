@@ -176,7 +176,8 @@ def _append_train(state: FrState, train: Train) -> FrState:
     )
 
 
-def _decide(state: FrState, super_majority: int, n_participants: int) -> FrState:
+def _decide(state: FrState, super_majority: int, n_participants: int,
+            packed: bool = False) -> FrState:
     """Warm-start windowed frontier walk + fame + received over the
     maintained tables.
 
@@ -248,11 +249,11 @@ def _decide(state: FrState, super_majority: int, n_participants: int) -> FrState
     )
 
     ss, votes0, wvalid, coin_w = _fame_setup_tables(
-        wvalid, la_w, fd_w, idx_w, coin_w, super_majority
+        wvalid, la_w, fd_w, idx_w, coin_w, super_majority, packed=packed
     )
     fame = _decide_fame_tables(
         ss, votes0, wvalid, coin_w, fr.last_round,
-        super_majority, n_participants, r_cap + 2,
+        super_majority, n_participants, r_cap + 2, packed=packed,
     )
     min_la, famous_count, i_ok, horizon = _received_tables_from(
         wvalid, la_w, fame.decided, fame.famous, fame.rounds_decided,
@@ -286,26 +287,29 @@ def _decide(state: FrState, super_majority: int, n_participants: int) -> FrState
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants"),
+    static_argnames=("super_majority", "n_participants", "packed"),
     donate_argnames=("state",),
 )
 def frontier_train_step(
-    state: FrState, train: Train, super_majority: int, n_participants: int
+    state: FrState, train: Train, super_majority: int, n_participants: int,
+    packed: bool = False,
 ) -> FrState:
     """One whole append train + walk + fame + received, as a single device
     program with donated (in-place) state."""
     return _decide(
-        _append_train(state, train), super_majority, n_participants
+        _append_train(state, train), super_majority, n_participants,
+        packed=packed,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants"),
+    static_argnames=("super_majority", "n_participants", "packed"),
     donate_argnames=("state",),
 )
 def frontier_multi_train(
-    state: FrState, stacked: Train, super_majority: int, n_participants: int
+    state: FrState, stacked: Train, super_majority: int, n_participants: int,
+    packed: bool = False,
 ) -> FrState:
     """K stacked trains appended in one device program (scan of the append
     body — appends don't need intermediate decisions), then one walk +
@@ -316,7 +320,7 @@ def frontier_multi_train(
         return _append_train(st, t), None
 
     out, _ = jax.lax.scan(body, state, stacked)
-    return _decide(out, super_majority, n_participants)
+    return _decide(out, super_majority, n_participants, packed=packed)
 
 
 # ---------------------------------------------------------------------------
@@ -324,12 +328,13 @@ def frontier_multi_train(
 # ---------------------------------------------------------------------------
 
 _bootstrap_decide = functools.partial(
-    jax.jit, static_argnames=("super_majority", "n_participants")
+    jax.jit, static_argnames=("super_majority", "n_participants", "packed")
 )(_decide)
 
 
 def bootstrap_frontier_state(
     grid, e_cap: int, l_cap: int, r_cap: int, n_participants: int,
+    packed: bool = False,
 ) -> FrState:
     """Build a ready FrState for an EXISTING deep base-state DAG without
     replaying it through append trains: the full frontier history comes
@@ -415,4 +420,6 @@ def bootstrap_frontier_state(
         coin=put(coin_np),
         count=jnp.int32(e),
     )
-    return _bootstrap_decide(state, grid.super_majority, n_participants)
+    return _bootstrap_decide(
+        state, grid.super_majority, n_participants, packed=packed
+    )

@@ -36,6 +36,7 @@ import numpy as np
 
 from .kernels import MAX_INT32, received_core, suffix_min
 from .grid import DagGrid
+from .packed import pack_bits, pack_votes_t, packed_count, packed_tally, popcount_sum
 
 # cap for "no first descendant yet" sentinels on the fp32/MXU compare path:
 # every real event index is < 2^24 (fp32-exact), so a 2^24 sentinel loses
@@ -122,13 +123,19 @@ D_UNROLL = 8
 
 
 def _fame_window(w_valid, la_w, fd_w, idx_w, coin_w, last_round_rel,
-                 super_majority: int, n_participants: int):
+                 super_majority: int, n_participants: int,
+                 packed: bool = False):
     """DecideFame over a contiguous round window, all tables dense
-    (the buffer-resident mirror of kernels._fame_setup + _decide_fame)."""
+    (the buffer-resident mirror of kernels._fame_setup + _decide_fame).
+    With `packed` (tpu/packed.py) the strongly-see tensor and the carried
+    vote matrix hold their voted-witness axis in uint32 lanes and the
+    tallies are popcount reductions — integer-identical, so every
+    decision is byte-equal to the wide window."""
     r_win, n = w_valid.shape
 
     fd_prev = jnp.roll(fd_w, 1, axis=0)
-    counts = jnp.sum(la_w[:, :, None, :] >= fd_prev[:, None, :, :], axis=-1)
+    cmp = la_w[:, :, None, :] >= fd_prev[:, None, :, :]
+    counts = packed_count(cmp) if packed else jnp.sum(cmp, axis=-1)
     prev_valid = jnp.roll(w_valid, 1, axis=0).at[0].set(False)
     ss = (counts >= super_majority) & w_valid[:, :, None] & prev_valid[:, None, :]
 
@@ -138,12 +145,15 @@ def _fame_window(w_valid, la_w, fd_w, idx_w, coin_w, last_round_rel,
     votes0 = see0 & valid_y0[:, :, None]
 
     i_arr = jnp.arange(r_win)
+    if packed:
+        ss_p = pack_bits(ss)  # (r_win, N_y, W)
+        total_p = popcount_sum(ss_p)
 
     # statically unrolled voting offsets: straight-line XLA, no dynamic
     # control flow. Decisions needing d > D_UNROLL+1 (e.g. contested coin
     # scenarios) are reported through the overflow flag; the caller falls
     # back to the full pipeline for those rare states.
-    votes = votes0
+    votes = pack_votes_t(votes0) if packed else votes0
     decided = jnp.zeros((r_win, n), bool)
     famous = jnp.zeros((r_win, n), bool)
     for d in range(2, 2 + D_UNROLL):
@@ -153,16 +163,21 @@ def _fame_window(w_valid, la_w, fd_w, idx_w, coin_w, last_round_rel,
         j_ok = (j <= last_round_rel) & (j <= r_win - 1)
         jc = jnp.clip(j, 0, r_win - 1)
 
-        ss_d = ss[jc] & j_ok[:, None, None]
         vy = w_valid[jc] & j_ok[:, None]
 
-        yays = jnp.einsum(
-            "ryw,rwx->ryx",
-            ss_d.astype(jnp.float32),
-            votes.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)
-        total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
+        if packed:
+            ss_d = jnp.where(j_ok[:, None, None], ss_p[jc], jnp.uint32(0))
+            yays = packed_tally(ss_d, votes)
+            total = jnp.where(j_ok[:, None], total_p[jc], 0)
+        else:
+            ss_d = ss[jc] & j_ok[:, None, None]
+            yays = jnp.einsum(
+                "ryw,rwx->ryx",
+                ss_d.astype(jnp.float32),
+                votes.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
         nays = total[:, :, None] - yays
         v = yays >= nays
         t = jnp.where(v, yays, nays)
@@ -182,6 +197,9 @@ def _fame_window(w_valid, la_w, fd_w, idx_w, coin_w, last_round_rel,
             famous = jnp.where(any_decide, fame_val, famous)
             decided = decided | any_decide
             votes = v
+        if packed:
+            # voters y of this step are the next step's voted witnesses
+            votes = pack_votes_t(votes)
 
     rounds_decided = jnp.all(decided | ~w_valid, axis=1) & jnp.any(w_valid, axis=1)
     # undecided witnesses needing votes beyond the unroll OR the window top
@@ -227,6 +245,7 @@ def _step_body(
     batch: Batch,
     super_majority: int,
     n_participants: int,
+    packed: bool = False,
 ) -> IncState:
     """Append one batch: fd deltas, new rows, rounds/lamport/witness and
     witness-buffer registration. Fame/received live in _decide_body."""
@@ -258,9 +277,16 @@ def _step_body(
         wvalid = (wtable[pr] >= 0) & (parent_round[:, None] >= 0)  # (W, N)
         fd_ws = fd_w[pr]  # (W, N, N) — dense slice, no row gathers
         la_e = batch.la_rows[p]  # (W, N)
-        counts = jnp.sum(la_e[:, None, :] >= fd_ws, axis=-1, dtype=jnp.int32)
-        ss = (counts >= super_majority) & wvalid
-        c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
+        if packed:
+            counts = packed_count(la_e[:, None, :] >= fd_ws)
+            ss = (counts >= super_majority) & wvalid
+            c_seen = packed_count(ss)
+        else:
+            counts = jnp.sum(
+                la_e[:, None, :] >= fd_ws, axis=-1, dtype=jnp.int32
+            )
+            ss = (counts >= super_majority) & wvalid
+            c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
 
         new_round = parent_round + (c_seen >= super_majority).astype(jnp.int32)
         fixed = batch.fixed_round[p]
@@ -338,6 +364,7 @@ def _decide_body(
     n_participants: int,
     r_win: int = 32,
     e_win: int = 8192,
+    packed: bool = False,
 ) -> IncState:
     """Fame + round-received over the current state. Timing-independent:
     candidacy per fully-decided round is stable (its famous set is final
@@ -369,7 +396,7 @@ def _decide_body(
                                          (r_win,) + a.shape[1:])
     dec_w, fam_w, rdec_w, fame_overflow = _fame_window(
         sl(wtable) >= 0, sl(la_w), sl(fd_w), sl(idx_w), sl(coin_w),
-        last_round - floor, super_majority, n_participants,
+        last_round - floor, super_majority, n_participants, packed=packed,
     )
     # freeze mask: when the slice start was clipped below floor_true,
     # entries for already-settled rounds keep their stored values
@@ -438,23 +465,29 @@ def _decide_body(
 
 
 def _step_full(state, batch, super_majority, n_participants,
-               r_win: int = 32, e_win: int = 8192):
+               r_win: int = 32, e_win: int = 8192, packed: bool = False):
     return _decide_body(
-        _step_body(state, batch, super_majority, n_participants),
+        _step_body(state, batch, super_majority, n_participants,
+                   packed=packed),
         super_majority, n_participants, r_win=r_win, e_win=e_win,
+        packed=packed,
     )
 
 
 step = functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_win", "e_win"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_win", "e_win", "packed",
+    ),
     donate_argnames=("state",),
 )(_step_full)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_win", "e_win"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_win", "e_win", "packed",
+    ),
     donate_argnames=("state",),
 )
 def multi_step(
@@ -464,6 +497,7 @@ def multi_step(
     n_participants: int,
     r_win: int = 32,
     e_win: int = 8192,
+    packed: bool = False,
 ) -> IncState:
     """Apply K append batches in ONE device program (lax.scan over the
     append body) followed by one fame + round-received pass. Bit-identical
@@ -473,11 +507,12 @@ def multi_step(
     dispatches one call per K syncs."""
 
     def body(st, b):
-        return _step_body(st, b, super_majority, n_participants), None
+        return _step_body(st, b, super_majority, n_participants,
+                          packed=packed), None
 
     out, _ = jax.lax.scan(body, state, stacked)
     return _decide_body(out, super_majority, n_participants,
-                        r_win=r_win, e_win=e_win)
+                        r_win=r_win, e_win=e_win, packed=packed)
 
 
 def stack_batches(batches):
@@ -521,7 +556,7 @@ class Train(NamedTuple):
 
 
 def _train_body(state: IncState, train: Train, super_majority: int,
-                n_participants: int) -> IncState:
+                n_participants: int, packed: bool = False) -> IncState:
     """Append a whole train: deltas + row staging once, then a level scan
     over small buffers, then one write-back scatter. Bit-identical to
     running the constituent batches through ``_step_body`` one by one
@@ -599,10 +634,15 @@ def _train_body(state: IncState, train: Train, super_majority: int,
             & (parent_round[:, None] >= 0)
         )  # (W, N)
         la_e_f = train.la_rows[p].astype(jnp.float32)  # (W, N)
-        counts = jnp.sum(
-            la_e_f[:, None, :] >= fd_ws, axis=-1, dtype=jnp.int32)
-        ss = (counts >= super_majority) & wvalid
-        c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
+        if packed:
+            counts = packed_count(la_e_f[:, None, :] >= fd_ws)
+            ss = (counts >= super_majority) & wvalid
+            c_seen = packed_count(ss)
+        else:
+            counts = jnp.sum(
+                la_e_f[:, None, :] >= fd_ws, axis=-1, dtype=jnp.int32)
+            ss = (counts >= super_majority) & wvalid
+            c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
 
         new_round = parent_round + (c_seen >= super_majority).astype(jnp.int32)
         fixed = train.fixed_round[p]
@@ -713,7 +753,9 @@ def _train_body(state: IncState, train: Train, super_majority: int,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_win", "e_win"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_win", "e_win", "packed",
+    ),
     donate_argnames=("state",),
 )
 def train_step(
@@ -723,18 +765,23 @@ def train_step(
     n_participants: int,
     r_win: int = 32,
     e_win: int = 8192,
+    packed: bool = False,
 ) -> IncState:
     """One whole append train + one fame/round-received pass, as a single
     device program. The throughput path of the incremental engine."""
     return _decide_body(
-        _train_body(state, train, super_majority, n_participants),
+        _train_body(state, train, super_majority, n_participants,
+                    packed=packed),
         super_majority, n_participants, r_win=r_win, e_win=e_win,
+        packed=packed,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_win", "e_win"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_win", "e_win", "packed",
+    ),
     donate_argnames=("state",),
 )
 def multi_train(
@@ -744,6 +791,7 @@ def multi_train(
     n_participants: int,
     r_win: int = 32,
     e_win: int = 8192,
+    packed: bool = False,
 ) -> IncState:
     """Apply K whole trains in ONE device program (scan of _train_body)
     followed by one fame + round-received pass. The offline-replay
@@ -752,11 +800,12 @@ def multi_train(
     (decisions are timing-independent, see _decide_body)."""
 
     def body(st, t):
-        return _train_body(st, t, super_majority, n_participants), None
+        return _train_body(st, t, super_majority, n_participants,
+                           packed=packed), None
 
     out, _ = jax.lax.scan(body, state, stacked)
     return _decide_body(out, super_majority, n_participants,
-                        r_win=r_win, e_win=e_win)
+                        r_win=r_win, e_win=e_win, packed=packed)
 
 
 def stack_trains(trains):

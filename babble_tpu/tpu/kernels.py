@@ -44,6 +44,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .packed import pack_bits, pack_votes_t, packed_count, packed_tally, popcount_sum
+
 MAX_INT32 = 2**31 - 1
 MIN_INT32 = -(2**31)
 
@@ -109,7 +111,7 @@ def _divide_rounds(
     levels, creator, index, self_parent, other_parent, la, fd,
     ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport, ext_op_lamport,
     fixed_lamport,
-    super_majority: int, r_max: int,
+    super_majority: int, r_max: int, packed: bool = False,
 ) -> DivideRoundsResult:
     e_count, n = la.shape
 
@@ -134,9 +136,17 @@ def _divide_rounds(
         wvalid = (wrows >= 0) & (parent_round[:, None] >= 0)
         fd_w = fd[jnp.maximum(wrows, 0)]  # (N_lvl, N, N)
         la_e = la[rows]  # (N_lvl, N)
-        counts = jnp.sum(la_e[:, None, :] >= fd_w, axis=-1, dtype=jnp.int32)
-        ss = (counts >= super_majority) & wvalid
-        c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
+        if packed:
+            # packed ancestry-comparison tally: the (N_lvl, N, N) compare
+            # mask packs into uint32 lanes and popcounts — same integers,
+            # zero-filled padding lanes contribute nothing
+            counts = packed_count(la_e[:, None, :] >= fd_w)
+            ss = (counts >= super_majority) & wvalid
+            c_seen = packed_count(ss)
+        else:
+            counts = jnp.sum(la_e[:, None, :] >= fd_w, axis=-1, dtype=jnp.int32)
+            ss = (counts >= super_majority) & wvalid
+            c_seen = jnp.sum(ss, axis=-1, dtype=jnp.int32)
 
         new_round = parent_round + (c_seen >= super_majority).astype(jnp.int32)
         # root-attached events have their round forced (reference root
@@ -174,17 +184,21 @@ def _divide_rounds(
     return DivideRoundsResult(rounds, witness, lamport, wtable)
 
 
-def _fame_setup_tables(wvalid, la_w, fd_w, idx_w, coin_w, super_majority: int):
+def _fame_setup_tables(wvalid, la_w, fd_w, idx_w, coin_w, super_majority: int,
+                       packed: bool = False):
     """DecideFame preamble from prebuilt per-witness tables: the
     round-adjacent strongly-see tensor and the d=1 ancestry votes
     (reference: hashgraph.go:875-884). Split out so callers that keep
     dense witness buffers (frontier_live.py, which derives fd_w from INV)
-    can skip the row gathers."""
+    can skip the row gathers. With `packed` the ancestry-comparison tally
+    runs as a popcount over uint32 lanes (tpu/packed.py) — integer-equal
+    to the wide sum."""
     r_max, n = wvalid.shape
 
     # ss[j, y, w]: witness y of round j strongly sees witness w of round j-1
     fd_prev = jnp.roll(fd_w, 1, axis=0)
-    counts = jnp.sum(la_w[:, :, None, :] >= fd_prev[:, None, :, :], axis=-1)
+    cmp = la_w[:, :, None, :] >= fd_prev[:, None, :, :]
+    counts = packed_count(cmp) if packed else jnp.sum(cmp, axis=-1)
     prev_valid = jnp.roll(wvalid, 1, axis=0).at[0].set(False)
     ss = (counts >= super_majority) & wvalid[:, :, None] & prev_valid[:, None, :]
 
@@ -197,27 +211,43 @@ def _fame_setup_tables(wvalid, la_w, fd_w, idx_w, coin_w, super_majority: int):
     return ss, votes0, wvalid, coin_w
 
 
-def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int):
+def _fame_setup(wtable, la, fd, index, coin_bit, super_majority: int,
+                packed: bool = False):
     """Shared DecideFame preamble: gather per-witness tables, then the
     table math (_fame_setup_tables)."""
     wvalid = wtable >= 0
     wrows = jnp.maximum(wtable, 0)
     return _fame_setup_tables(
         wvalid, la[wrows], fd[wrows], index[wrows], coin_bit[wrows],
-        super_majority,
+        super_majority, packed=packed,
     )
 
 
 def _decide_fame_tables(
     ss, votes0, wvalid, coin_w, last_round,
     super_majority: int, n_participants: int, d_cap: int,
+    packed: bool = False,
 ) -> FameResult:
     """Virtual voting from a prebuilt strongly-see tensor, batched over
     every round i at once; while_loop over the round offset d (j = i + d)
-    with bit-exact early exit."""
+    with bit-exact early exit.
+
+    With `packed` (tpu/packed.py) the loop-resident state shrinks 8x: the
+    strongly-see tensor and the carried vote matrix pack their
+    voted-witness axis into uint32 lanes, and the yay tally becomes
+    sum-of-popcounts over ANDed words — integer-identical to the wide
+    float32 einsum (0/1 products, sums far below f32's exact range), so
+    every decision below is byte-equal to the wide program. The per-step
+    vote verdict v is computed wide (it is the next step's vote input and
+    the coin substitution reads wide coin bits) and re-packed transposed
+    for the next tally; zero-filled padding lanes never contribute to a
+    popcount."""
     r_max, n = wvalid.shape
 
     i_arr = jnp.arange(r_max)
+    if packed:
+        ss_p = pack_bits(ss)  # (R, N_y, W): witness axis in uint32 lanes
+        total_p = popcount_sum(ss_p)  # (R, N_y), ss row tallies
 
     def cond(carry):
         votes, decided, famous, d = carry
@@ -231,16 +261,24 @@ def _decide_fame_tables(
         j_ok = j <= last_round
         jc = jnp.clip(j, 0, r_max - 1)
 
-        ss_d = ss[jc] & j_ok[:, None, None]  # (R, N_y, N_w)
         vy = wvalid[jc] & j_ok[:, None]  # voter validity (R, N_y)
 
-        yays = jnp.einsum(
-            "ryw,rwx->ryx",
-            ss_d.astype(jnp.float32),
-            votes.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)
-        total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)  # (R, N_y)
+        if packed:
+            # votes carries the TRANSPOSED-packed matrix (R, N_x, W):
+            # both tally operands pack the voter axis, so AND + popcount
+            # is the binary GEMM (packed.packed_tally)
+            ss_d = jnp.where(j_ok[:, None, None], ss_p[jc], jnp.uint32(0))
+            yays = packed_tally(ss_d, votes)  # (R, N_y, N_x) int32
+            total = jnp.where(j_ok[:, None], total_p[jc], 0)
+        else:
+            ss_d = ss[jc] & j_ok[:, None, None]  # (R, N_y, N_w)
+            yays = jnp.einsum(
+                "ryw,rwx->ryx",
+                ss_d.astype(jnp.float32),
+                votes.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)  # (R, N_y)
         nays = total[:, :, None] - yays
         v = yays >= nays
         t = jnp.where(v, yays, nays)
@@ -262,10 +300,13 @@ def _decide_fame_tables(
 
         coin_votes = jnp.where(strong, v, coin_w[jc][:, :, None])
         votes_next = jnp.where(is_coin, coin_votes, v)
+        if packed:
+            # this step's voters y are the next step's voted witnesses w
+            votes_next = pack_votes_t(votes_next)
         return (votes_next, decided, famous, d + 1)
 
     init = (
-        votes0,
+        pack_votes_t(votes0) if packed else votes0,
         jnp.zeros((r_max, n), dtype=bool),
         jnp.zeros((r_max, n), dtype=bool),
         jnp.int32(2),
@@ -280,14 +321,15 @@ def _decide_fame_tables(
 def _decide_fame(
     wtable, la, fd, index, coin_bit, last_round,
     super_majority: int, n_participants: int, d_cap: int,
+    packed: bool = False,
 ) -> FameResult:
     """Virtual voting with tables gathered from the flat event arrays."""
     ss, votes0, wvalid, coin_w = _fame_setup(
-        wtable, la, fd, index, coin_bit, super_majority
+        wtable, la, fd, index, coin_bit, super_majority, packed=packed
     )
     return _decide_fame_tables(
         ss, votes0, wvalid, coin_w, last_round,
-        super_majority, n_participants, d_cap,
+        super_majority, n_participants, d_cap, packed=packed,
     )
 
 
@@ -374,7 +416,10 @@ def _decide_round_received(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("super_majority", "n_participants", "r_max", "r_fame", "d_cap"),
+    static_argnames=(
+        "super_majority", "n_participants", "r_max", "r_fame", "d_cap",
+        "packed",
+    ),
 )
 def consensus_pipeline(
     levels: jax.Array,  # (L, N) int32 event rows, -1 padded
@@ -396,6 +441,7 @@ def consensus_pipeline(
     r_max: int,
     r_fame: int,
     d_cap: int,
+    packed: bool = False,
 ) -> PipelineResult:
     """DivideRounds + DecideFame + DecideRoundReceived as one XLA program.
 
@@ -410,13 +456,13 @@ def consensus_pipeline(
     dr = _divide_rounds(
         levels, creator, index, self_parent, other_parent, la, fd,
         ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport,
-        ext_op_lamport, fixed_lamport, super_majority, r_max,
+        ext_op_lamport, fixed_lamport, super_majority, r_max, packed=packed,
     )
     last_round = jnp.max(dr.rounds)
     wtable = dr.witness_table[:r_fame]
     fame = _decide_fame(
         wtable, la, fd, index, coin_bit, last_round,
-        super_majority, n_participants, d_cap,
+        super_majority, n_participants, d_cap, packed=packed,
     )
     received = _decide_round_received(
         wtable, la, index, creator, dr.rounds,
@@ -437,12 +483,13 @@ def consensus_pipeline(
 
 # -- individually-jitted kernels (tests, sharded dryrun) ---------------------
 
-divide_rounds = functools.partial(jax.jit, static_argnames=("super_majority", "r_max"))(
-    _divide_rounds
-)
+divide_rounds = functools.partial(
+    jax.jit, static_argnames=("super_majority", "r_max", "packed")
+)(_divide_rounds)
 
 decide_fame = functools.partial(
-    jax.jit, static_argnames=("super_majority", "n_participants", "d_cap")
+    jax.jit,
+    static_argnames=("super_majority", "n_participants", "d_cap", "packed"),
 )(_decide_fame)
 
 decide_round_received = jax.jit(_decide_round_received)

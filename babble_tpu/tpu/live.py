@@ -38,6 +38,7 @@ from .incremental import (
     stack_batches,
     step,
 )
+from .packed import observe_table_bytes, packed_enabled
 
 
 def derive_fd_updates(grid: DagGrid) -> List[List[Tuple[int, int, int]]]:
@@ -110,6 +111,11 @@ class LiveDeviceEngine:
         # clamped rounds slip past the guard (code review r5). The default
         # is the deliberate 64-wide window (see ENGINE_DEFAULTS).
         self.r_win = min(d["r_win"] if r_win is None else r_win, self.r_cap)
+        # voting-table layout, resolved once at engine construction so
+        # every step/multi_step dispatch compiles one consistent program
+        # (tpu/packed.py; per-engine override via BABBLE_PACKED_VOTING)
+        self.packed = packed_enabled(self.n)
+        observe_table_bytes(hg.obs, self.n, self.r_win, self.packed)
         self.round_base = 0
         self.rebases = 0
         # latency accounting: device dispatches vs result fetches — the
@@ -231,7 +237,7 @@ class LiveDeviceEngine:
         for b in batches_from_grid(grid, self.batch_cap, self.upd_cap, self.e_cap):
             self.state = step(
                 self.state, b, self.hg.super_majority, self.n,
-                e_win=self.e_win, r_win=self.r_win,
+                e_win=self.e_win, r_win=self.r_win, packed=self.packed,
             )
 
     def _attach_base_round(self):
@@ -568,7 +574,7 @@ class LiveDeviceEngine:
             for b in built:
                 self.state = step(
                     self.state, b, self.hg.super_majority, self.n,
-                    e_win=self.e_win, r_win=self.r_win,
+                    e_win=self.e_win, r_win=self.r_win, packed=self.packed,
                 )
                 self.dispatches += 1
         else:
@@ -578,7 +584,8 @@ class LiveDeviceEngine:
                 group = group + [self._empty_batch()] * (k - len(group))
                 self.state = multi_step(
                     self.state, stack_batches(group),
-                    self.hg.super_majority, self.n, e_win=self.e_win, r_win=self.r_win,
+                    self.hg.super_majority, self.n, e_win=self.e_win,
+                    r_win=self.r_win, packed=self.packed,
                 )
                 self.dispatches += 1
         dt = clock.monotonic() - t0

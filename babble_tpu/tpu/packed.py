@@ -1,0 +1,207 @@
+"""Bit-packed voting state: uint32 lane packing + popcount tallies
+(ISSUE 17, ROADMAP item 3).
+
+The O(r*N^2) virtual-voting working set — the strongly-seen tensor, the
+yay/nay vote matrix and the ancestry-comparison masks behind them — is
+pure boolean information, but the wide kernels hold it in bool/int32
+arrays and tally it with `jnp.sum` reductions, so memory and bandwidth
+scale up to 32x worse than the information content. This module packs the
+VALIDATOR axis of those tables into uint32 lanes:
+
+    word w, bit k  <->  validator column w * 32 + k      (little-endian)
+
+so a boolean row of N validator columns becomes ceil(N/32) uint32 words,
+and every super-majority tally becomes a `lax.population_count` reduction
+over the packed words:
+
+    count(row)        = sum_w popcount(row_p[w])
+    yays[y, x]        = sum_w popcount(ss_p[y, w] & votesT_p[x, w])
+
+The binary "GEMM" on the second line is the packed form of the fame
+einsum `yays = ss @ votes`: both operands pack the SAME (voted-witness)
+axis, so the AND selects exactly the voters y strongly sees that vote yay
+on x, and the popcount is the integer tally — bit-exactly equal to the
+wide float32 einsum (whose products are 0/1 and whose sums stay far below
+f32's integer range). XLA fuses the AND + popcount into the reduction, so
+nothing (R, N, N, W)-sized is ever materialized.
+
+Padding neutrality: `pack_bits` zero-fills the trailing partial lane, and
+0-bits contribute 0 to every popcount — so non-lane-aligned validator
+counts (and the mesh's witness-axis padding columns) are vote-neutral by
+construction, the same argument the wide path makes for its padded
+columns (ss False => garbage vote rows tally 0).
+
+Round/lamport/witness-index tables stay wide (int32): they carry values,
+not set membership.
+
+The layout is a process-wide knob (`packed_voting` in node.Config,
+`--packed-voting` on the CLI, env `BABBLE_PACKED_VOTING=<0|1|auto>`;
+env wins so operators can flip a running deployment's default without a
+config push). Every engine entry point also accepts an explicit
+`packed=` override so the differential tests and the bench can compare
+both layouts in one process. Byte-equality packed-vs-wide is gated at
+every existing equality site (tests/test_packed.py, bench_mesh_scale.py,
+dryrun_multichip); any divergence is owned by the PR 11 bisector
+(obs/provenance.py), which localizes it to a (pass, table, round,
+witness) cell.
+
+NOTE: no module-level jnp array constants here (same import-purity
+contract as kernels.py — creating one would initialize the default TPU
+backend as an import side effect; tests/test_multichip.py pins this).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+LANE = 32
+
+# "auto" threshold: below this the packed working set fits in cache either
+# way and the repack per voting step costs more than the bandwidth saved
+# (measured on the CPU backend: packed wins clearly from N=128 up and is
+# ~6x at N=1024; at N<=64 the wide einsum is already cache-resident)
+PACKED_AUTO_MIN_N = 128
+
+# process-wide default, set once by node.Core from config/CLI; the env
+# var (read per call, so tests can monkeypatch it) overrides it
+_MODE = "auto"
+_VALID_MODES = ("0", "1", "auto")
+
+
+def set_packed_mode(mode: str) -> None:
+    """Install the process-wide packed-voting mode ("0" | "1" | "auto")."""
+    global _MODE
+    mode = str(mode).strip().lower()
+    if mode not in _VALID_MODES:
+        raise ValueError(
+            f"packed_voting must be one of {_VALID_MODES}, got {mode!r}"
+        )
+    _MODE = mode
+
+
+def packed_mode() -> str:
+    """Effective mode: BABBLE_PACKED_VOTING when set, else the installed
+    process default."""
+    env = os.environ.get("BABBLE_PACKED_VOTING", "").strip().lower()
+    if env in _VALID_MODES:
+        return env
+    return _MODE
+
+
+def packed_enabled(n_participants: int) -> bool:
+    """Resolve the mode for a grid of `n_participants` validators."""
+    mode = packed_mode()
+    if mode == "1":
+        return True
+    if mode == "0":
+        return False
+    return n_participants >= PACKED_AUTO_MIN_N
+
+
+def resolve_packed(packed: Optional[bool], n_participants: int) -> bool:
+    """Per-call override (`packed=` kwarg) falling back to the knob."""
+    return packed_enabled(n_participants) if packed is None else bool(packed)
+
+
+def packed_words(n: int) -> int:
+    """uint32 words per packed row of n validator columns."""
+    return (n + LANE - 1) // LANE
+
+
+# ---------------------------------------------------------------------------
+# lane packing / popcount tallies (trace-time helpers, shape-static)
+# ---------------------------------------------------------------------------
+
+
+def pack_bits(x: jax.Array) -> jax.Array:
+    """Pack the trailing boolean axis into uint32 lanes (little-endian:
+    bit k of word w is element w*32+k). The trailing partial lane is
+    zero-filled — vote-neutral under every popcount tally."""
+    n = x.shape[-1]
+    w = packed_words(n)
+    pad = w * LANE - n
+    x = x.astype(bool)
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    xr = x.reshape(x.shape[:-1] + (w, LANE))
+    weights = jnp.uint32(1) << jnp.arange(LANE, dtype=jnp.uint32)
+    # distinct powers of two: the sum is an exact bitwise assembly
+    return jnp.sum(xr.astype(jnp.uint32) * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(xp: jax.Array, n: int) -> jax.Array:
+    """Inverse of pack_bits: expand packed words back to n boolean lanes."""
+    bits = (
+        xp[..., None] >> jnp.arange(LANE, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    flat = bits.reshape(xp.shape[:-1] + (xp.shape[-1] * LANE,))
+    return flat[..., :n].astype(bool)
+
+
+def popcount_sum(xp: jax.Array) -> jax.Array:
+    """Total set-bit count over the trailing word axis (int32) — the
+    packed form of `jnp.sum(bool_row, axis=-1, dtype=int32)`."""
+    return jnp.sum(
+        jax.lax.population_count(xp).astype(jnp.int32), axis=-1,
+        dtype=jnp.int32,
+    )
+
+
+def packed_count(x: jax.Array) -> jax.Array:
+    """Count True lanes along the trailing axis via pack + popcount;
+    integer-identical to the wide `jnp.sum(x, axis=-1, dtype=int32)`."""
+    return popcount_sum(pack_bits(x))
+
+
+def packed_tally(ss_p: jax.Array, votes_t_p: jax.Array) -> jax.Array:
+    """Binary GEMM over packed words: for ss_p (..., Y, W) and votes_t_p
+    (..., X, W) — both packing the SAME voted-witness axis — returns the
+    (..., Y, X) int32 tally sum_w popcount(ss_p[y] & votes_t_p[x]), the
+    packed form of the fame einsum `ss @ votes`."""
+    joint = ss_p[..., :, None, :] & votes_t_p[..., None, :, :]
+    return popcount_sum(joint)
+
+
+def pack_votes_t(votes: jax.Array) -> jax.Array:
+    """Pack a (..., W_voters, X) vote matrix into its transposed packed
+    form (..., X, words(W_voters)) — the operand layout packed_tally
+    expects (the voter axis is the packed one)."""
+    return pack_bits(jnp.swapaxes(votes, -1, -2))
+
+
+# ---------------------------------------------------------------------------
+# device-resident table accounting (ISSUE 17 satellite: the layout claim
+# as a measured series, not a comment)
+# ---------------------------------------------------------------------------
+
+
+def voting_table_bytes(n: int, r_rounds: int, packed: bool) -> dict:
+    """Device-resident bytes of the (R, N, N-lane) voting tables in the
+    given layout: bool lanes wide, uint32 words packed."""
+    per_row = 4 * packed_words(n) if packed else n
+    return {
+        "strongly_seen": r_rounds * n * per_row,
+        "votes": r_rounds * n * per_row,
+    }
+
+
+def observe_table_bytes(obs, n: int, r_rounds: int, packed: bool) -> dict:
+    """Publish the voting-table footprint of the layout that just ran
+    (gauge `babble_device_table_bytes`, labels table/layout), surfaced in
+    /stats, the dryrun headline and bench registry snapshots."""
+    layout = "packed" if packed else "wide"
+    gauge = obs.gauge(
+        "babble_device_table_bytes",
+        "Device-resident bytes per voting table in the active layout",
+        labels=("table", "layout"),
+    )
+    sizes = voting_table_bytes(n, r_rounds, packed)
+    for table, nbytes in sizes.items():
+        gauge.labels(table=table, layout=layout).set(nbytes)
+    return sizes

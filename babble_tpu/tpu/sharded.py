@@ -37,6 +37,9 @@ from . import kernels
 from .engine import PassResults
 from .frontier import frontier_post
 from .grid import DagGrid, MAX_INT32
+from .packed import (
+    LANE, pack_bits, pack_votes_t, packed_tally, popcount_sum, resolve_packed,
+)
 
 # jax.shard_map is top-level only from jax 0.5; 0.4.x ships it under
 # experimental with the same signature, but its replication checker
@@ -98,7 +101,8 @@ def _pad_axis0(a: np.ndarray, size: int, fill) -> np.ndarray:
 
 @functools.lru_cache(maxsize=16)
 def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
-                  super_majority: int, d_bound: int, v_axis=None):
+                  super_majority: int, d_bound: int, v_axis=None,
+                  packed: bool = False):
     """Build the shard_mapped fame voting pass for a mesh: the WHOLE
     voting loop runs in one dispatch, early-exiting ON DEVICE via a
     lax.while_loop whose continue-flag is a psum across the mesh
@@ -115,7 +119,18 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
     of the (B, N_y, N_x) yay/total counts over the validator axis, and
     each shard slices its own witness rows back out of the replicated
     next-vote tensor — per-shard local voting plus one all-reduce per
-    step, the MPC per-machine-shard discipline (ISSUE 9)."""
+    step, the MPC per-machine-shard discipline (ISSUE 9).
+
+    With `packed` (tpu/packed.py) the two big boolean carries pack their
+    voted-witness axis into uint32 lanes: ss_s is (B, N_y, W) and votes
+    carries the TRANSPOSED-packed (B, N_x, W) matrix, BOTH sharding the
+    word axis over v_axis — the caller lane-aligns the witness padding to
+    32*ndev_v so every shard owns whole words. The local tally is AND +
+    popcount over the local words; the SAME int32 psum closes it (packing
+    changes what each device holds, not what crosses the interconnect),
+    so the collective pattern — and every decision — is identical to the
+    wide program. The per-step vote handoff re-packs the replicated wide
+    next-vote tensor and slices the local words back out."""
     ndev_r = int(mesh.shape[axis])
     # send my first row to the previous device: a left ring-shift of the
     # globally R-sharded j-aligned tensors (along the rounds axis only —
@@ -134,16 +149,25 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
             j = i_rows + d  # absolute voter round per local row
             j_ok = j <= last_round
 
-            ss_d = ss_s & j_ok[:, None, None]  # (B, N_y, N_w)
             vy = wv_s & j_ok[:, None]  # (B, N_y)
 
-            yays = jnp.einsum(
-                "ryw,rwx->ryx",
-                ss_d.astype(jnp.float32),
-                votes.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            ).astype(jnp.int32)
-            total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
+            if packed:
+                # local AND + popcount over this shard's words; the psum
+                # below closes the partial int32 tallies exactly as wide
+                ss_d = jnp.where(
+                    j_ok[:, None, None], ss_s, jnp.uint32(0)
+                )  # (B, N_y, W_local)
+                yays = packed_tally(ss_d, votes)
+                total = popcount_sum(ss_d)
+            else:
+                ss_d = ss_s & j_ok[:, None, None]  # (B, N_y, N_w)
+                yays = jnp.einsum(
+                    "ryw,rwx->ryx",
+                    ss_d.astype(jnp.float32),
+                    votes.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+                total = jnp.sum(ss_d, axis=-1, dtype=jnp.int32)
             if v_axis is not None:
                 # close the witness-shard partial tallies: one psum per
                 # voting step over the validator axis
@@ -170,9 +194,19 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
 
             coin_votes = jnp.where(strong, v, coin_s[:, :, None])
             new_votes = jnp.where(is_coin, coin_votes, v)
-            if v_axis is not None:
+            if packed:
                 # voters y of this step are the voted witnesses w of the
-                # next: each shard keeps only its witness-row slice
+                # next: repack transposed, then (on a 2-D mesh) keep only
+                # this shard's whole-word slice of the packed voter axis
+                new_votes = pack_votes_t(new_votes)  # (B, N_x, W)
+                if v_axis is not None:
+                    w_words = votes.shape[2]
+                    off = jax.lax.axis_index(v_axis) * w_words
+                    new_votes = jax.lax.dynamic_slice_in_dim(
+                        new_votes, off, w_words, axis=2
+                    )
+            elif v_axis is not None:
+                # each shard keeps only its witness-row slice
                 w_local = votes.shape[1]
                 off = jax.lax.axis_index(v_axis) * w_local
                 new_votes = jax.lax.dynamic_slice_in_dim(
@@ -214,10 +248,11 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
 
     shp2 = P(axis, None)
     rep = P()
-    # votes carry the witness axis in dim 1, the strongly-see tensor in
-    # dim 2; on 1-D meshes v_axis is None and the P entries collapse to
-    # the fully-replicated trailing dims of the original layout
-    votes_spec = P(axis, v_axis, None)
+    # wide: votes carry the voter axis in dim 1, the strongly-see tensor
+    # carries the voted-witness axis in dim 2; packed: BOTH carry the
+    # packed word axis in dim 2. On 1-D meshes v_axis is None and the P
+    # entries collapse to the fully-replicated trailing dims
+    votes_spec = P(axis, None, v_axis) if packed else P(axis, v_axis, None)
     ss_spec = P(axis, None, v_axis)
     # buffer donation (ISSUE 6): votes/decided/famous/ss_s/wv_s/coin_s
     # (positions 3-8) are freshly device_put per call by
@@ -283,7 +318,7 @@ def _fame_tables(wtable, la, decided, famous, last_round):
 
 def _sharded_fame_received(
     mesh, grid: DagGrid, wtable_np, la, fd, index, rounds_np, last_round,
-    chunk: int,
+    chunk: int, packed=None,
 ):
     """Passes 2+3 over the mesh, shared by the level-scan and frontier
     entry points: rounds-sharded fame voting with ring-shifted voters,
@@ -292,7 +327,12 @@ def _sharded_fame_received(
     additionally partitioned over the witness axis, so per-device fame
     state is (R/dr, N, N/dv) instead of (R/dr, N, N) — the validator
     memory ceiling scales out with the mesh (ISSUE 9 tentpole leg 2).
+    With `packed` the witness axis is additionally lane-packed into
+    uint32 words and the witness padding is aligned to 32*ndev_v so
+    every validator shard owns whole words (tpu/packed.py shard-boundary
+    rule) — per-device fame state drops another 8x.
     Returns host numpy results."""
+    pk = resolve_packed(packed, grid.n)
     axis, v_axis = _mesh_axes(mesh)
     ndev_r = int(mesh.shape[axis])
     ndev_v = int(mesh.shape[v_axis]) if v_axis is not None else 1
@@ -301,15 +341,22 @@ def _sharded_fame_received(
     rep = NamedSharding(mesh, P())
     shard_r = NamedSharding(mesh, P(axis))
     shard_r2 = NamedSharding(mesh, P(axis, None))
-    # witness-axis partitioning (None entries collapse on 1-D meshes):
+    # witness-axis partitioning (None entries collapse on 1-D meshes);
+    # packed layouts shard the word axis of both carries (dim 2)
     shard_ss = NamedSharding(mesh, P(axis, None, v_axis))
-    shard_votes = NamedSharding(mesh, P(axis, v_axis, None))
+    shard_votes = NamedSharding(
+        mesh, P(axis, None, v_axis) if pk else P(axis, v_axis, None)
+    )
     shard_coin = NamedSharding(mesh, P(axis, None))
 
     r_rows = wtable_np.shape[0]
     r_pad = ((r_rows + ndev_r - 1) // ndev_r) * ndev_r
     e_pad = ((max(grid.e, 1) + ndev - 1) // ndev) * ndev
-    n_pad_v = ((grid.n + ndev_v - 1) // ndev_v) * ndev_v
+    # packed witness padding is lane-aligned per shard (32*ndev_v) so the
+    # word axis splits evenly across validator shards; extra padded
+    # columns/rows are vote-neutral (ss False, wv False), same as wide
+    n_quant = LANE * ndev_v if pk else ndev_v
+    n_pad_v = ((grid.n + n_quant - 1) // n_quant) * n_quant
 
     putr = lambda x: jax.device_put(np.asarray(x), rep)
     wtable = putr(_pad_axis0(wtable_np, r_pad, -1))
@@ -329,10 +376,16 @@ def _sharded_fame_received(
         wv_y = jnp.pad(wvalid, ((0, 0), (0, padw)))
         coin_y = jnp.pad(coin_w, ((0, 0), (0, padw)))
     # j-aligned buffers start at d0=2: a global left-shift by 2
-    ss_s = jax.device_put(jnp.roll(ss_y, -2, axis=0), shard_ss)
+    if pk:
+        # pack once on host-side staging: ss packs its witness axis,
+        # votes pack their voter axis transposed (packed_tally layout)
+        ss_s = jax.device_put(pack_bits(jnp.roll(ss_y, -2, axis=0)), shard_ss)
+        votes = jax.device_put(pack_votes_t(votes0), shard_votes)
+    else:
+        ss_s = jax.device_put(jnp.roll(ss_y, -2, axis=0), shard_ss)
+        votes = jax.device_put(votes0, shard_votes)
     wv_s = jax.device_put(jnp.roll(wv_y, -2, axis=0), shard_r2)
     coin_s = jax.device_put(jnp.roll(coin_y, -2, axis=0), shard_coin)
-    votes = jax.device_put(votes0, shard_votes)
     wvalid_s = jax.device_put(wvalid, shard_r2)
     decided = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
     famous = jax.device_put(np.zeros((r_pad, grid.n), bool), shard_r2)
@@ -342,7 +395,8 @@ def _sharded_fame_received(
     # (d_bound bucketed to the padded round count so the compiled
     # executable is reused across similarly-sized batches)
     fame_loop = _fame_loop_fn(
-        mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2, v_axis
+        mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2, v_axis,
+        packed=pk,
     )
     votes, decided, famous = fame_loop(
         last_round, i_rows, wvalid_s, votes, decided, famous,
@@ -369,9 +423,12 @@ def _sharded_fame_received(
     )
 
 
-def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults:
+def sharded_run_passes(
+    mesh: Mesh, grid: DagGrid, chunk: int = 8, packed=None,
+) -> PassResults:
     """Full three-pass pipeline over a device mesh; results identical to
     the single-device `engine.run_passes` (differential-tested)."""
+    pk = resolve_packed(packed, grid.n)
     rep = NamedSharding(mesh, P())
     r_max = grid.r_max
 
@@ -389,7 +446,7 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
         putr(grid.ext_sp_round), putr(grid.ext_op_round),
         putr(grid.fixed_round), putr(grid.ext_sp_lamport),
         putr(grid.ext_op_lamport), putr(grid.fixed_lamport),
-        grid.super_majority, r_max,
+        grid.super_majority, r_max, packed=pk,
     )
     last_round = jnp.max(dr.rounds)
 
@@ -397,7 +454,7 @@ def sharded_run_passes(mesh: Mesh, grid: DagGrid, chunk: int = 8) -> PassResults
     rounds_np = np.asarray(dr.rounds)
     decided, famous, rounds_decided, received = _sharded_fame_received(
         mesh, grid, np.asarray(dr.witness_table), la, fd, index,
-        rounds_np, last_round, chunk,
+        rounds_np, last_round, chunk, packed=pk,
     )
 
     return PassResults(
@@ -538,7 +595,8 @@ def _frontier_walk_fn(mesh: Mesh, axis, super_majority: int, r_cap: int,
 
 
 def sharded_frontier_passes(
-    mesh: Mesh, grid: DagGrid, chunk: int = 8, r_cap: int = None
+    mesh: Mesh, grid: DagGrid, chunk: int = 8, r_cap: int = None,
+    packed=None,
 ) -> PassResults:
     """The round-frontier pipeline over a device mesh: INV/chain tables
     sharded over chains, fame rounds-sharded, received events-sharded.
@@ -617,6 +675,7 @@ def sharded_frontier_passes(
     rounds_np = np.asarray(fr.rounds)[:e_real]
     decided, famous, rounds_decided, received = _sharded_fame_received(
         mesh, grid, wtable_np, la, fd, index, rounds_np, last_round, chunk,
+        packed=packed,
     )
 
     return PassResults(
@@ -638,7 +697,7 @@ def sharded_frontier_passes(
 
 
 def sharded_doubling_passes(
-    mesh: Mesh, grid: DagGrid, chunk: int = 8, stats=None,
+    mesh: Mesh, grid: DagGrid, chunk: int = 8, stats=None, packed=None,
 ) -> PassResults:
     """Cold-path pipeline with pass 1 (pointer-doubling closure +
     contracted walk) running replicated on the mesh devices and passes
@@ -665,7 +724,7 @@ def sharded_doubling_passes(
     index = putr(grid.index)
     decided, famous, rounds_decided, received = _sharded_fame_received(
         mesh, grid, wtable_np, la, fd, index, rounds_np,
-        putr(np.int32(last_round)), chunk,
+        putr(np.int32(last_round)), chunk, packed=packed,
     )
 
     rounds = rounds_np

@@ -13,7 +13,13 @@ through ordering:
 - round_batched — the ISSUE 9 rung: BATCH_SYNCS syncs accumulate into
                   ONE dispatch that rides the pointer-doubling cold path
                   (use_doubling prefer=True), so the fixed dispatch
-                  overhead amortizes across every round in the batch.
+                  overhead amortizes across every round in the batch;
+- packed        — the ISSUE 17 rung: the sync discipline with the
+                  uint32 bit-packed voting-table layout (tpu/packed.py —
+                  lane packing + popcount tallies). Byte-equality-gated
+                  against the same oracle as the wide sync column it is
+                  compared to; the per-rung speedup_vs_wide and
+                  table-bytes reduction are the packed headline.
 
 Every discipline's pass results are byte-equality-gated against the CPU
 oracle (run_frontier_passes) before any number is reported — the
@@ -42,6 +48,11 @@ Prints the headline as the LAST line (driver-parsable):
 discipline must sustain a mean of at least --slo-min-rounds (default 4)
 rounds per dispatch, declared as a mean_above SLO objective (obs/slo.py)
 and evaluated once; breach exits nonzero with the report on stderr.
+When the sweep reaches --slo-packed-n validators (default 1024 — the
+ISSUE 17 crossover point), --slo additionally gates on the packed
+discipline's speedup over wide sync at the largest such rung staying at
+or above --slo-min-packed-speedup (default 1.0: packed ms/call must not
+exceed wide ms/call).
 
 The default sweep (8,64,128) plus the anchor runs in a few minutes on
 the CPU mesh — the 8-validator rung is directly comparable to
@@ -87,9 +98,10 @@ def _bisect_gate(grid, out, ref, label):
         )
 
 
-def slo_gate(obs, min_rounds: float):
-    """Declare the rounds-per-dispatch floor and evaluate once. Returns
-    (ok, status_doc)."""
+def slo_gate(obs, min_rounds: float, packed_floor=None, packed_n=None):
+    """Declare the rounds-per-dispatch floor — and, when the sweep
+    reached the packed crossover rung, the packed-speedup floor — then
+    evaluate once. Returns (ok, status_doc)."""
     from babble_tpu.obs import SLOEngine
 
     slo = SLOEngine(obs)
@@ -100,6 +112,16 @@ def slo_gate(obs, min_rounds: float):
         description="round-batched dispatches keep covering at least "
                     "this many consensus rounds each",
     )
+    if packed_n is not None:
+        slo.objective(
+            "mesh_packed_speedup",
+            series="babble_bench_packed_speedup",
+            kind="above", threshold=packed_floor,
+            labels={"validators": str(packed_n)},
+            description="bit-packed voting tables stay at least this "
+                        "much faster than the wide layout at the "
+                        "largest crossover-scale rung",
+        )
     status = slo.evaluate()
     return not slo.breached(), status
 
@@ -124,7 +146,7 @@ def build_mesh(devices, validator_shards):
     return mesh, n_dev, dv
 
 
-def run_sweep_point(mesh, n, events, oracle_cache):
+def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
     """One validator count: build the grid, gate every discipline against
     the CPU oracle, return the per-discipline numbers."""
     import numpy as np
@@ -155,8 +177,11 @@ def run_sweep_point(mesh, n, events, oracle_cache):
             _bisect_gate(grid, out, ref, f"mesh-sweep-n{n}")
             raise
 
-    # compile + warm both device paths outside the timed loops
+    # compile + warm every device path outside the timed loops; the
+    # packed warm call doubles as the per-point byte-equality gate the
+    # ISSUE 17 discipline requires (gate() bisects on divergence)
     gate(sharded_frontier_passes(mesh, grid))
+    gate(sharded_frontier_passes(mesh, grid, packed=True))
     gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())
 
     wall, blocked, dispatches = {}, {}, {}
@@ -171,6 +196,18 @@ def run_sweep_point(mesh, n, events, oracle_cache):
         b += time.perf_counter() - tb
     wall["sync"] = time.perf_counter() - t0
     blocked["sync"], dispatches["sync"] = b, CALLS
+
+    # -- packed: the sync discipline under the uint32 lane layout ---------
+    t0 = time.perf_counter()
+    b = 0.0
+    for _ in range(CALLS):
+        gossip_stage()
+        tb = time.perf_counter()
+        out = sharded_frontier_passes(mesh, grid, packed=True)
+        b += time.perf_counter() - tb
+    gate(out)
+    wall["packed"] = time.perf_counter() - t0
+    blocked["packed"], dispatches["packed"] = b, CALLS
 
     # -- queued: bounded queue, one dispatch per sync ---------------------
     t0 = time.perf_counter()
@@ -221,7 +258,7 @@ def run_sweep_point(mesh, n, events, oracle_cache):
     blocked["round_batched"], dispatches["round_batched"] = b, n_disp
 
     total_rounds = int(ref.last_round) + 1
-    return {
+    point = {
         name: {
             "events_per_sec": round(events / wall[name], 1),
             "ms_per_call": round(blocked[name] / CALLS * 1e3, 2),
@@ -229,8 +266,29 @@ def run_sweep_point(mesh, n, events, oracle_cache):
             "rounds_per_dispatch": round(total_rounds / dispatches[name], 2),
             "wall_s": round(wall[name], 3),
         }
-        for name in ("sync", "queued", "round_batched")
+        for name in ("sync", "packed", "queued", "round_batched")
     }
+    # the packed column's two headline figures: blocked-time speedup over
+    # the wide sync column it differs from by layout alone, and the
+    # device-resident voting-table footprint of each layout
+    from babble_tpu.tpu.packed import observe_table_bytes, voting_table_bytes
+
+    r_tab = int(ref.witness_table.shape[0])
+    tb_wide = sum(voting_table_bytes(n, r_tab, False).values())
+    tb_packed = sum(voting_table_bytes(n, r_tab, True).values())
+    if obs is not None:
+        # both layouts into the babble_device_table_bytes gauge so the
+        # registry snapshot in the archived JSON carries the footprint
+        # (last sweep rung wins — the headline scale)
+        observe_table_bytes(obs, n, r_tab, False)
+        observe_table_bytes(obs, n, r_tab, True)
+    point["packed"]["speedup_vs_wide"] = round(
+        blocked["sync"] / max(blocked["packed"], 1e-9), 2
+    )
+    point["packed"]["table_bytes"] = tb_packed
+    point["packed"]["table_bytes_wide"] = tb_wide
+    point["packed"]["table_bytes_reduction"] = round(tb_wide / tb_packed, 2)
+    return point
 
 
 def run_catchup_anchor(mesh, events, rpd_hist):
@@ -333,6 +391,21 @@ def main(argv=None):
     ap.add_argument("--slo-min-rounds", type=float, default=4.0,
                     help="Floor on mean consensus rounds covered per "
                          "batched dispatch for --slo")
+    ap.add_argument("--slo-min-packed-speedup", type=float, default=1.0,
+                    help="Floor on the packed discipline's blocked-time "
+                         "speedup over wide sync at the largest rung at "
+                         "or past --slo-packed-n (1.0 = packed ms/call "
+                         "must not exceed wide ms/call)")
+    ap.add_argument("--slo-packed-n", type=int, default=1024,
+                    help="Validator count from which the packed-speedup "
+                         "floor applies (the ISSUE 17 crossover scale); "
+                         "sweeps that stay under it skip that objective")
+    ap.add_argument("--headline", choices=("round_batched", "packed"),
+                    default="round_batched",
+                    help="Which discipline's events/s at the largest "
+                         "sweep point is the driver-parsable headline "
+                         "value (make bench-packed archives the packed "
+                         "series as BENCH_PACKED_r*.json)")
     args = ap.parse_args(argv)
 
     import jax
@@ -367,17 +440,26 @@ def main(argv=None):
         "babble_mesh_validator_shards",
         "Validator-axis shards in the active mesh layout",
     ).set(dv)
+    spd = obs.gauge(
+        "babble_bench_packed_speedup",
+        "Blocked-time speedup of the bit-packed voting-table layout over "
+        "the wide layout, by validator count",
+        labels=("validators",),
+    )
 
     oracle_cache = {}
     per_n = {}
     for n in sweep:
         events = args.events or min(4 * n, 2048)
-        per_n[str(n)] = run_sweep_point(mesh, n, events, oracle_cache)
+        per_n[str(n)] = run_sweep_point(mesh, n, events, oracle_cache, obs)
         for name, d in per_n[str(n)].items():
             lat.labels(path=name, validators=str(n)).observe(
                 d["ms_per_call"] / 1e3
             )
             thr.labels(path=name, validators=str(n)).set(d["events_per_sec"])
+        spd.labels(validators=str(n)).set(
+            per_n[str(n)]["packed"]["speedup_vs_wide"]
+        )
 
     anchor = None
     if args.anchor_events:
@@ -388,23 +470,28 @@ def main(argv=None):
         anchor["rounds_per_dispatch"] if anchor
         else top["round_batched"]["rounds_per_dispatch"]
     )
+    hname = {"round_batched": "round-batched", "packed": "bit-packed"}
     print(
         json.dumps(
             {
                 "metric": (
-                    "events ordered/sec through the round-batched sharded "
-                    f"mesh, validator sweep {sweep[0]}..{sweep[-1]}, "
+                    f"events ordered/sec through the {hname[args.headline]} "
+                    f"sharded mesh, validator sweep {sweep[0]}..{sweep[-1]}, "
                     f"mesh={n_dev}dev x{dv} validator shards, "
                     f"platform={devices[0].platform}"
                 ),
-                "value": top["round_batched"]["events_per_sec"],
+                "value": top[args.headline]["events_per_sec"],
                 "unit": "events/s",
                 "vs_baseline": round(
-                    top["round_batched"]["events_per_sec"]
+                    top[args.headline]["events_per_sec"]
                     / max(top["sync"]["events_per_sec"], 1e-9), 2
                 ),
                 "rounds_per_dispatch": headline_rpd,
                 "validator_shards": dv,
+                "packed_speedup": top["packed"]["speedup_vs_wide"],
+                "table_bytes_reduction": (
+                    top["packed"]["table_bytes_reduction"]
+                ),
                 "catchup_anchor": anchor,
                 "validators": per_n,
                 "metrics": obs.registry.snapshot(),
@@ -413,17 +500,28 @@ def main(argv=None):
     )
 
     if args.slo:
-        ok, status = slo_gate(obs, args.slo_min_rounds)
+        packed_rungs = [n for n in sweep if n >= args.slo_packed_n]
+        ok, status = slo_gate(
+            obs, args.slo_min_rounds,
+            packed_floor=args.slo_min_packed_speedup,
+            packed_n=max(packed_rungs) if packed_rungs else None,
+        )
         print(
             "SLO gate:",
             json.dumps(status["objectives"], sort_keys=True),
             file=sys.stderr,
         )
         if not ok:
+            breached = [
+                o["name"] for o in status["objectives"] if o["breached"]
+            ]
             print(
-                f"SLO BREACH: round-batched dispatches covered "
-                f"{headline_rpd} rounds/dispatch, under the "
-                f"{args.slo_min_rounds} floor",
+                f"SLO BREACH ({', '.join(breached)}): round-batched "
+                f"dispatches covered {headline_rpd} rounds/dispatch "
+                f"(floor {args.slo_min_rounds}); packed speedup at the "
+                f"top rung {top['packed']['speedup_vs_wide']}x (floor "
+                f"{args.slo_min_packed_speedup} from "
+                f"N={args.slo_packed_n})",
                 file=sys.stderr,
             )
             return 1

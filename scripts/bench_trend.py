@@ -170,6 +170,10 @@ def main():
             "tx/s", max,
         ),
         (
+            "mesh packed throughput", "BENCH_PACKED_r*.json", bench_value,
+            "events/s", max,
+        ),
+        (
             "ingest submit->commit p99", "BENCH_INGEST_r*.json",
             ingest_p99_value, "s", min,
         ),

@@ -40,6 +40,13 @@ python -m babble_tpu explain --smoke "${BABBLE_BISECT_SEEDS:-3}" || rc=1
 echo "== babble-tpu ingest smoke (hard gate) =="
 JAX_PLATFORMS=cpu python bench_ingest.py --smoke --slo || rc=1
 
+# Packed-voting smoke (hard gate, ISSUE 17): two seeded grids through the
+# one-shot + frontier pipelines in both voting-table layouts — uint32
+# lane packing must be byte-equal to wide on every pass output; a
+# divergence is bisected to its exact cell before the nonzero exit.
+echo "== babble-tpu packed-voting smoke (hard gate) =="
+JAX_PLATFORMS=cpu python scripts/packed_smoke.py || rc=1
+
 echo "== ruff (advisory) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"

@@ -1,0 +1,79 @@
+"""CI smoke for the bit-packed voting layout (ISSUE 17, tpu/packed.py).
+
+Two seeded synthetic grids — one non-lane-aligned (n=7: 25 padding lanes
+in play), one crossing a word boundary (n=33) — run through the one-shot
+and frontier pipelines in BOTH layouts; every pass output must be
+byte-equal. On divergence the PR 11 bisector localizes the earliest
+divergent (pass, table, round, witness) cell to stderr before the
+nonzero exit, so a CI failure is triage-ready. A few seconds on CPU.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIXTURES = (
+    (7, 160, 9),   # non-lane-aligned validator count
+    (33, 320, 4),  # crosses the uint32 word boundary
+)
+
+
+def main() -> int:
+    import numpy as np
+
+    from babble_tpu.obs import bisect_pass_results
+    from babble_tpu.tpu.engine import run_frontier_passes, run_passes
+    from babble_tpu.tpu.grid import synthetic_grid
+
+    failures = 0
+    for n, e, seed in FIXTURES:
+        grid = synthetic_grid(n, e, seed=seed)
+        for name, fn in (("oneshot", run_passes),
+                         ("frontier", run_frontier_passes)):
+            wide = fn(grid, packed=False)
+            packed = fn(grid, packed=True)
+            try:
+                for f in ("rounds", "witness", "lamport", "fame_decided",
+                          "rounds_decided", "received"):
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(wide, f)),
+                        np.asarray(getattr(packed, f)), f,
+                    )
+                np.testing.assert_array_equal(
+                    np.asarray(wide.famous) & np.asarray(wide.fame_decided),
+                    np.asarray(packed.famous)
+                    & np.asarray(packed.fame_decided),
+                )
+                assert int(wide.last_round) == int(packed.last_round)
+            except AssertionError as exc:
+                failures += 1
+                print(
+                    f"packed_smoke: DIVERGENCE n={n} seed={seed} {name}: "
+                    f"{exc}",
+                    file=sys.stderr,
+                )
+                loc, path = bisect_pass_results(
+                    grid, "wide", wide, "packed", packed,
+                    label=f"packed-smoke-n{n}-{name}",
+                )
+                if loc is not None:
+                    print(
+                        "packed_smoke: bisected to round %s %s/%s cell %s"
+                        % (loc["round"], loc["pass"], loc["table"],
+                           (loc.get("cell") or "")[:18]),
+                        file=sys.stderr,
+                    )
+                continue
+            print(f"packed_smoke: n={n} seed={seed} {name}: "
+                  "packed == wide on all pass outputs")
+    if failures:
+        print(f"packed_smoke: FAIL ({failures} divergent arms)",
+              file=sys.stderr)
+        return 1
+    print("packed_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -709,6 +709,38 @@ def test_staging_audit_covers_doubling_cold_path(tmp_path):
     assert clean.files_checked == 1
 
 
+def test_staging_audit_covers_packed_kernels(tmp_path):
+    """ISSUE 17: the bit-packed voting module (tpu/packed.py) sits inside
+    the staging-audit + determinism scope like every other kernel module:
+    a tracer-branch violation seeded into a scratch copy of the REAL
+    module must fire, and the checked-in module itself must stay clean
+    with the (empty) shipped baseline."""
+    real = Path(REPO_ROOT) / "babble_tpu" / "tpu" / "packed.py"
+    src = real.read_text()
+    seeded = src + (
+        "\n\n@jax.jit\n"
+        "def _seeded_probe(x):\n"
+        "    if x.sum() > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    p = tmp_path / "babble_tpu" / "tpu" / "packed.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(seeded)
+    found = _lint(tmp_path).new
+    assert [(f.rule, f.symbol) for f in found] == [
+        ("jax-tracer-branch", "_seeded_probe")
+    ]
+    assert found[0].line > len(src.splitlines())
+
+    clean = run_lint(
+        REPO_ROOT, paths=["babble_tpu/tpu/packed.py"], baseline_path=None
+    )
+    assert clean.errors == []
+    assert [f.location() for f in clean.new] == []
+    assert clean.files_checked == 1
+
+
 def test_staging_audit_covers_batched_dispatch_path(tmp_path):
     """ISSUE 9: the round-batched dispatch path (tpu/dispatch.py staging
     through GridStager, tpu/sharded.py 2-D fame loop) must stay inside

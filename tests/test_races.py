@@ -321,6 +321,9 @@ def test_default_modules_cover_the_lock_scope():
     assert "babble_tpu.tpu.dispatch" in DEFAULT_MODULES
     assert "babble_tpu.node.node" in DEFAULT_MODULES
     assert "babble_tpu.obs.metrics" in DEFAULT_MODULES
+    # ISSUE 17: the packed-layout module rides every engine rung the two
+    # lines above certify, so it joins the race-certification scope too
+    assert "babble_tpu.tpu.packed" in DEFAULT_MODULES
     import importlib
 
     for mod in DEFAULT_MODULES:
