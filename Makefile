@@ -21,12 +21,20 @@ race:
 
 # consensus-grade static analysis (babble_tpu/analysis/, docs/analysis.md):
 # determinism lint + lock-discipline checker + JAX staging audit +
+# staged-kernel contract checker (--staged: kernel-* rules over tpu/) +
 # observability lint (obs-*: static metric names, literal label sets).
-# Hard gate. ruff/mypy are an advisory second tier — they run only where
-# installed (pip install -e '.[lint]'); the container image does not
-# ship them.
+# Hard gate, with a hard <30s wall-time budget so it stays cheap enough
+# to run on every edit. ruff/mypy are an advisory second tier — they run
+# only where installed (pip install -e '.[lint]'); the container image
+# does not ship them.
 lint:
-	$(PY) -m babble_tpu lint
+	@start=$$(date +%s); \
+	$(PY) -m babble_tpu lint --staged || exit 1; \
+	elapsed=$$(( $$(date +%s) - start )); \
+	if [ "$$elapsed" -ge 30 ]; then \
+		echo "lint: FAIL — hard gate took $${elapsed}s, over the 30s wall-time budget"; \
+		exit 1; \
+	fi
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check babble_tpu/; \
 	else \

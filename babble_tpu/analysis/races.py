@@ -61,11 +61,18 @@ MUTATOR_METHODS = {
     "clear", "move_to_end", "rotate", "sort", "reverse",
 }
 
-_WAIVER_TAGS = ("det-ok", "unguarded-ok", "jax-ok", "obs-ok", "lint-ok")
+_WAIVER_TAGS = ("det-ok", "unguarded-ok", "jax-ok", "obs-ok", "kernel-ok",
+                "retrace-ok", "lint-ok")
+# tags only the staged-kernel contract checker (staged.py) consumes —
+# they can't be audited on runs where `lint --staged` didn't execute
+_STAGED_ONLY_TAGS = ("kernel-ok", "retrace-ok")
 _REASONED_WAIVER = re.compile(
     r"^(%s)\s*:\s*\S" % "|".join(_WAIVER_TAGS)
 )
 _GUARDED_BY_COMMENT = re.compile(r"^guarded-by:\s*[A-Za-z_][A-Za-z0-9_]*")
+_KERNEL_CONTRACT_COMMENT = re.compile(
+    r"^kernel-contract:\s*[A-Za-z_][A-Za-z0-9_]*"
+)
 
 
 def _lock_factory_call(node: ast.AST, threading_aliases: Set[str],
@@ -378,7 +385,7 @@ def check_races(sf: SourceFile) -> Iterable[Finding]:
 
 
 def check_dead_waivers(
-    sf: SourceFile, lock_scope: bool
+    sf: SourceFile, lock_scope: bool, staged_scope: "Optional[bool]" = None
 ) -> Iterable[Finding]:
     """`lint-dead-waiver`. MUST run after every other checker family on
     this SourceFile: it audits `sf.used_waiver_lines`, which the other
@@ -388,18 +395,44 @@ def check_dead_waivers(
     - a `# guarded-by:` declaration that no checker matched to a shared
       access is dead (in lock-scope files); outside the lock scope the
       declaration is unenforced and therefore misleading — also dead.
+    - `# kernel-contract:` / `kernel-ok:` / `retrace-ok:` annotations
+      belong to the staged-kernel checker (staged.py). `staged_scope`
+      mirrors `lock_scope`: True means the checker ran on this file (its
+      own findings then own every contract diagnosis — bound contracts
+      are marked used, stale ones are kernel-contract findings), False
+      means `--staged` ran but this file is outside the staging scope
+      (an annotation here is unenforced, hence dead), None means the
+      checker didn't run at all this invocation, so those annotations
+      are skipped rather than misreported as dead.
     """
     findings: List[Finding] = []
     for ln in sorted(sf.comments):
         c = sf.comments[ln]
         dead_reason = None
         if _REASONED_WAIVER.match(c):
+            tag = c.split(":", 1)[0].strip()
+            if tag in _STAGED_ONLY_TAGS and staged_scope is not True:
+                continue  # not auditable on a run without --staged
             if ln not in sf.used_waiver_lines:
-                tag = c.split(":", 1)[0].strip()
                 dead_reason = (
                     f"`# {tag}:` waiver suppresses no finding; the code it "
                     "excused has moved or been fixed — delete the comment "
                     "(stale waivers mask real regressions)"
+                )
+        elif _KERNEL_CONTRACT_COMMENT.match(c):
+            if staged_scope is None:
+                continue
+            if not staged_scope:
+                dead_reason = (
+                    "`# kernel-contract:` annotation in a file outside the "
+                    "staged-analysis scope: the contract is not checked "
+                    "here — move it next to the staged kernel or drop it"
+                )
+            elif ln not in sf.used_waiver_lines:
+                dead_reason = (
+                    "`# kernel-contract:` block not consumed by the "
+                    "staged-kernel checker — the header line must read "
+                    "`# kernel-contract: <staged function name>`"
                 )
         elif _GUARDED_BY_COMMENT.match(c):
             if not lock_scope:

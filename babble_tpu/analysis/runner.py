@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -22,6 +23,7 @@ from .determinism import check_determinism
 from .locks import check_locks
 from .obs import check_obs
 from .races import check_dead_waivers, check_races
+from .staged import check_staged
 from .staging import check_staging
 
 # modules where replica-identical computation is decided: the five-pass
@@ -100,8 +102,10 @@ def _discover(root: str, paths: Optional[List[str]]) -> List[Tuple[str, str]]:
     return sorted(set(out))
 
 
-def lint_file(sf: SourceFile) -> List[Finding]:
-    """All checker families applicable to one parsed file, by scope."""
+def lint_file(sf: SourceFile, staged: bool = False) -> List[Finding]:
+    """All checker families applicable to one parsed file, by scope.
+    `staged` enables the kernel-contract checker (`lint --staged`) on
+    files in the staging scope."""
     findings: List[Finding] = []
     if _matches(sf.path, EXCLUDED_PREFIXES):
         return findings
@@ -115,11 +119,24 @@ def lint_file(sf: SourceFile) -> List[Finding]:
     if lock_scope:
         findings.extend(check_locks(sf))
         findings.extend(check_races(sf))
-    if _matches(sf.path, STAGING_SCOPE_PREFIXES):
+    staging_scope = _matches(sf.path, STAGING_SCOPE_PREFIXES)
+    if staging_scope:
         findings.extend(check_staging(sf))
+    # staged_scope for the dead-waiver audit: None = kernel-contract
+    # checking disabled this run (its annotations can't be audited),
+    # True = the checker ran on this file, False = enabled but the file
+    # is outside the staging scope (a kernel-contract there is dead)
+    staged_scope: Optional[bool] = None
+    if staged:
+        staged_scope = staging_scope
+        if staging_scope:
+            findings.extend(check_staged(sf))
     # MUST be last: it audits the waiver-usage record the families above
     # populate as they consume waivers (races.check_dead_waivers docstring)
-    findings.extend(check_dead_waivers(sf, lock_scope=lock_scope))
+    findings.extend(
+        check_dead_waivers(sf, lock_scope=lock_scope,
+                           staged_scope=staged_scope)
+    )
     return findings
 
 
@@ -153,6 +170,7 @@ def run_lint(
     paths: Optional[List[str]] = None,
     baseline_path: Optional[str] = DEFAULT_BASELINE,
     update_baseline: bool = False,
+    staged: bool = False,
 ) -> LintResult:
     result = LintResult()
     pairs: List[Tuple[Finding, str]] = []
@@ -163,7 +181,7 @@ def run_lint(
             result.errors.append(f"{relpath}: {e}")
             continue
         result.files_checked += 1
-        for f in lint_file(sf):
+        for f in lint_file(sf, staged=staged):
             pairs.append((f, sf.line_text(f.line)))
 
     if update_baseline:
@@ -233,6 +251,15 @@ def main(argv: Optional[List[str]] = None, root: Optional[str] = None) -> int:
     p.add_argument("--race-seeds", type=int, default=None, metavar="N",
                    help="Seed count for --races (default 5; `make race` "
                         "runs the full 50-seed acceptance sweep)")
+    p.add_argument("--staged", action="store_true",
+                   help="Also run the staged-kernel contract checker: "
+                        "abstract dtype/rank/layout/donation/mesh-axis "
+                        "interpretation of every jit/shard_map-staged "
+                        "function against its # kernel-contract: "
+                        "annotation (docs/analysis.md)")
+    p.add_argument("--contract-table", action="store_true",
+                   help="Print the generated kernel-contract markdown "
+                        "table (the docs/tpu.md embed) and exit")
     args = p.parse_args(argv)
     if args.race_seeds is not None:
         args.races = True
@@ -244,21 +271,34 @@ def main(argv: Optional[List[str]] = None, root: Optional[str] = None) -> int:
         root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
+    if args.contract_table:
+        from .staged import render_contract_table
+
+        print(render_contract_table(root))
+        return 0
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.no_baseline:
         baseline_path = None
+    t0 = time.perf_counter()
     result = run_lint(
         root,
         paths=args.paths or None,
         baseline_path=baseline_path,
         update_baseline=args.write_baseline,
+        staged=args.staged,
     )
+    elapsed = time.perf_counter() - t0
     if args.write_baseline:
         print(
             f"baseline written: {len(result.baselined)} finding(s) accepted"
         )
         return 0
     print(format_report(result, verbose_baselined=args.show_baselined))
+    # runtime goes on its own line, AFTER the findings/summary, so the
+    # finding stream itself stays byte-identical across runs (the
+    # determinism contract tests/test_staged.py asserts)
+    print(f"lint wall-time: {elapsed:.1f}s"
+          + (" (staged-kernel contracts included)" if args.staged else ""))
     rc = 0 if result.ok else 1
     if args.races:
         from .lockruntime import run_race_certification

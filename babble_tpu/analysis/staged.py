@@ -1,0 +1,1722 @@
+"""Staged-kernel contract checker (docs/analysis.md, ISSUE 18).
+
+The staging audit (staging.py) catches syntax-local hazards inside
+jit-staged functions. This module certifies the kernels themselves: an
+abstract interpreter propagates a small value lattice — dtype, shape
+rank, and voting-table *layout* (`wide` bool/int tables vs `packed`
+uint32 lane words, tpu/packed.py) — through assignments, calls,
+`lax.scan`/`while_loop`/`fori_loop` carries and returns of every staged
+function in the device engine, checked against declared
+`# kernel-contract:` annotations.
+
+Contract grammar (comment block; the header names the staged *def*, so
+wrapped forms like `step = partial(jax.jit, ...)(_step_full)` annotate
+`_step_full`):
+
+    # kernel-contract: local_fame
+    # rung: sharded
+    # in: last_round:i32[0] i_rows:i32[1] wvalid:bool[2]:wide
+    # in: votes:any[3]:dual ss_s:any[3]:dual wv_s:bool[2]:wide
+    # in: coin_s:bool[2]:wide decided:bool[2]:wide famous:bool[2]:wide
+    # mesh: axis v_axis
+    # donate: votes decided famous ss_s wv_s coin_s
+    # out: (votes, decided, famous)
+
+Directives: `in:` declares tracer params as `name:dtype[rank][:layout]`
+(dtype i32|u32|f32|bool|any|pytree; layout wide|packed|dual — `dual`
+means "wide or packed depending on the static `packed` flag");
+`static:` lists static_argnames; `donate:` lists donated buffers;
+`mesh:` the axis names (variables or strings) collectives may name;
+`rung:`/`out:` are documentation (the rung keys the generated contract
+table in docs/tpu.md). Every param must appear in `in:` or `static:`.
+
+Rule families (waiver tag `kernel-ok`; `retrace-ok` additionally waives
+kernel-retrace-hazard):
+
+- kernel-contract      — missing/stale/incomplete contract, or a
+  declared static/donate set that disagrees with the jit wrapper.
+- kernel-layout-mix    — a packed uint32 word table reaching einsum/
+  matmul/float consumers or being packed twice; a wide table reaching
+  `population_count`/`popcount_sum`/`packed_tally`; a traced select
+  (`jnp.where`/`concatenate`) joining a packed operand with a wide one.
+  Static `if packed:` / `if pk:` branches refine `dual` values to
+  `packed`/`wide` per branch (the repo's layout-knob idiom), so the
+  two layout programs are checked separately.
+- kernel-donate-reuse  — a buffer named in donate_argnums/argnames read
+  after the donating call, or a carried host-loop buffer
+  (`x = staged(x, ...)`) whose parameter is not donated.
+- kernel-mesh-axis     — `psum`/`ppermute`/`axis_index`/`all_gather`
+  naming an axis absent from the contract's `mesh:` set (collectives in
+  a function declaring no mesh always flag), and `P(...)` partition
+  specs in the shard_map factory naming undeclared axes.
+- kernel-retrace-hazard — a shard_map/jit factory that is not
+  lru_cached (every call re-traces: per-call Python closures fragment
+  the executable cache), or a contract-declared static missing from the
+  actual static_argnames.
+- kernel-carry-shape   — scan/while/fori carries whose abstract dtype/
+  rank/layout drifts between init and the body's returned carry (tuple
+  arity drift included).
+
+The interpreter is lexical and per-file like the rest of the framework:
+module-local helper calls are followed transitively (depth-capped),
+cross-module calls return unknown, and unknown joins unknown — rules
+only fire on *proven* conflicts, so the real tree stays at zero
+findings while the seeded-defect fixtures in tests/test_staged.py each
+fire exactly their family.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name
+from .staging import SHARD_MAP_CALLEES, _is_jit_expr, _static_argnames
+
+WAIVER = "kernel-ok"
+RETRACE_WAIVER = "retrace-ok"
+
+RULE_CONTRACT = "kernel-contract"
+RULE_LAYOUT = "kernel-layout-mix"
+RULE_DONATE = "kernel-donate-reuse"
+RULE_MESH = "kernel-mesh-axis"
+RULE_RETRACE = "kernel-retrace-hazard"
+RULE_CARRY = "kernel-carry-shape"
+
+KERNEL_RULES = (RULE_CONTRACT, RULE_LAYOUT, RULE_DONATE, RULE_MESH,
+                RULE_RETRACE, RULE_CARRY)
+
+CONTRACT_HEADER = re.compile(r"^kernel-contract:\s*([A-Za-z_][A-Za-z0-9_]*)\s*$")
+_DIRECTIVES = ("in:", "static:", "donate:", "mesh:", "rung:", "out:")
+_IN_TOKEN = re.compile(
+    r"^([A-Za-z_][A-Za-z0-9_]*):(i32|u32|f32|bool|any|pytree)"
+    r"(?:\[(\d+)\])?(?::(wide|packed|dual))?$"
+)
+
+# static names whose truthiness selects the voting-table layout — the
+# repo-wide knob (tpu/packed.py resolve_packed); `if packed:` refines
+# every `dual` value to `packed` in the branch and `wide` in the orelse
+LAYOUT_FLAG_NAMES = {"packed", "pk"}
+
+_DTYPE_TAILS = {
+    "int32": "i32", "int64": "i32", "int16": "i32", "int8": "i32",
+    "int_": "i32", "int": "i32",
+    "uint32": "u32", "uint64": "u32", "uint8": "u32",
+    "float32": "f32", "float64": "f32", "float16": "f32",
+    "bfloat16": "f32", "float_": "f32", "float": "f32",
+    "bool_": "bool", "bool": "bool",
+}
+_FLOAT_CTORS = {"float32", "float64", "float16", "bfloat16", "float_"}
+
+# consumers that must never see packed uint32 word tables
+_MATMUL_TAILS = {"einsum", "matmul", "dot", "tensordot", "dot_general"}
+
+_LRU_TAILS = {"lru_cache", "cache"}
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AbsVal:
+    """One point in the lattice; None fields are 'unknown' (top)."""
+
+    dtype: Optional[str] = None   # 'i32' | 'u32' | 'f32' | 'bool' | 'pytree'
+    rank: Optional[int] = None
+    layout: Optional[str] = None  # 'wide' | 'packed' | 'dual'
+
+
+UNKNOWN = AbsVal()
+
+
+@dataclass
+class FuncVal:
+    """A locally-defined function flowing as a value (scan/while bodies,
+    helpers) with the environment captured at its def site."""
+
+    node: ast.FunctionDef
+    closure: Dict[str, object]
+
+
+def _with(v: AbsVal, **kw) -> AbsVal:
+    return AbsVal(
+        dtype=kw.get("dtype", v.dtype),
+        rank=kw.get("rank", v.rank),
+        layout=kw.get("layout", v.layout),
+    )
+
+
+def _join_field(a, b):
+    if a == b:
+        return a
+    return None
+
+
+def _join_static(a: object, b: object) -> object:
+    """Join across a *static* fork (if packed: / IfExp): a wide/packed
+    layout conflict is the dual layout by construction, not a bug."""
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(_join_static(x, y) for x, y in zip(a, b))
+    if not isinstance(a, AbsVal) or not isinstance(b, AbsVal):
+        return UNKNOWN
+    lay = a.layout if a.layout == b.layout else (
+        "dual" if {a.layout, b.layout} == {"wide", "packed"} else None
+    )
+    return AbsVal(_join_field(a.dtype, b.dtype), _join_field(a.rank, b.rank),
+                  lay)
+
+
+def _layout_conflict(a: object, b: object) -> bool:
+    return (
+        isinstance(a, AbsVal) and isinstance(b, AbsVal)
+        and {a.layout, b.layout} == {"wide", "packed"}
+    )
+
+
+def _join_traced(a: object, b: object) -> object:
+    """Join across a traced select (jnp.where, concatenate): conflicts
+    collapse to unknown — the caller flags them via _layout_conflict."""
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(_join_traced(x, y) for x, y in zip(a, b))
+    if not isinstance(a, AbsVal) or not isinstance(b, AbsVal):
+        return UNKNOWN
+    lay = a.layout if a.layout == b.layout else (
+        a.layout if b.layout is None else (b.layout if a.layout is None
+                                           else None)
+    )
+    return AbsVal(_join_field(a.dtype, b.dtype), _join_field(a.rank, b.rank),
+                  lay)
+
+
+def _known_layout(*vals: object) -> Optional[str]:
+    """The single known wide/packed layout among operands, if coherent."""
+    lays = {v.layout for v in vals if isinstance(v, AbsVal) and v.layout}
+    lays.discard("dual")
+    if len(lays) == 1:
+        return lays.pop()
+    return None
+
+
+def _refine_layout(env: Dict[str, object], to: str) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in env.items():
+        if isinstance(v, AbsVal) and v.layout == "dual":
+            out[k] = _with(v, layout=to)
+        elif isinstance(v, tuple):
+            out[k] = tuple(
+                _with(e, layout=to)
+                if isinstance(e, AbsVal) and e.layout == "dual" else e
+                for e in v
+            )
+        else:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# contracts
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Contract:
+    name: str
+    header_line: int
+    lines: List[int] = field(default_factory=list)  # every comment line
+    args: Dict[str, AbsVal] = field(default_factory=dict)
+    statics: List[str] = field(default_factory=list)
+    donate: List[str] = field(default_factory=list)
+    mesh: List[str] = field(default_factory=list)
+    rung: str = ""
+    out: str = ""
+    malformed: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _parse_in_tokens(contract: Contract, line: int, rest: str) -> None:
+    for tok in rest.split():
+        m = _IN_TOKEN.match(tok)
+        if not m:
+            contract.malformed.append(
+                (line, f"unparseable `in:` token {tok!r} (grammar: "
+                       "name:dtype[rank][:layout], docs/analysis.md)")
+            )
+            continue
+        name, dt, rank, lay = m.groups()
+        contract.args[name] = AbsVal(
+            dtype=None if dt == "any" else dt,
+            rank=int(rank) if rank is not None else None,
+            layout=lay,
+        )
+
+
+def parse_contracts(sf: SourceFile) -> Dict[str, Contract]:
+    """{function name: Contract} from `# kernel-contract:` comment blocks.
+    Directive lines extend the block until the first non-directive line."""
+    contracts: Dict[str, Contract] = {}
+    for ln in sorted(sf.comments):
+        m = CONTRACT_HEADER.match(sf.comments[ln])
+        if not m:
+            continue
+        c = Contract(name=m.group(1), header_line=ln, lines=[ln])
+        cur = ln + 1
+        while cur in sf.comments and sf.line_text(cur).lstrip().startswith("#"):
+            text = sf.comments[cur]
+            directive = next(
+                (d for d in _DIRECTIVES if text.startswith(d)), None
+            )
+            if directive is None:
+                break
+            rest = text[len(directive):].strip()
+            if directive == "in:":
+                _parse_in_tokens(c, cur, rest)
+            elif directive == "static:":
+                c.statics.extend(rest.split())
+            elif directive == "donate:":
+                c.donate.extend(rest.split())
+            elif directive == "mesh:":
+                c.mesh.extend(rest.split())
+            elif directive == "rung:":
+                c.rung = rest
+            else:
+                c.out = rest
+            c.lines.append(cur)
+            cur += 1
+        if c.name in contracts:
+            c.malformed.append(
+                (ln, f"duplicate kernel-contract for {c.name!r}")
+            )
+        contracts.setdefault(c.name, c)
+        if c.malformed and c.name in contracts and contracts[c.name] is not c:
+            contracts[c.name].malformed.extend(c.malformed)
+    return contracts
+
+
+# ---------------------------------------------------------------------------
+# staged-function discovery (extends staging.find_staged_functions with
+# donation, shard_map kind, and the enclosing factory)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StagedFn:
+    name: str
+    node: ast.FunctionDef
+    statics: Tuple[str, ...] = ()
+    donated: Tuple[str, ...] = ()     # resolved to parameter names
+    kind: str = "jit"                 # 'jit' | 'shard_map'
+    factory: Optional[ast.FunctionDef] = None  # enclosing def, if nested
+    public_name: str = ""             # wrapper binding callers use
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _donate_kwargs(call: ast.Call, params: List[str]) -> Tuple[str, ...]:
+    out: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                out.extend(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+        elif kw.arg == "donate_argnums":
+            v = kw.value
+            nums: List[int] = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            out.extend(params[n] for n in nums if 0 <= n < len(params))
+    return tuple(out)
+
+
+def _jit_call_meta(node: ast.AST) -> Optional[ast.Call]:
+    """The Call carrying static/donate kwargs for a jit expression:
+    `jax.jit(...)` itself or the `functools.partial(jax.jit, ...)` call."""
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        if callee in ("jax.jit", "jit", "functools.partial", "partial"):
+            return node
+    return None
+
+
+def find_staged(sf: SourceFile) -> List[StagedFn]:
+    defs: Dict[str, ast.FunctionDef] = {}
+    parent: Dict[int, Optional[ast.FunctionDef]] = {}
+
+    def walk(node: ast.AST, enclosing: Optional[ast.FunctionDef]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                defs.setdefault(child.name, child)
+                parent[id(child)] = enclosing
+                walk(child, child)
+            else:
+                walk(child, enclosing)
+
+    walk(sf.tree, None)
+
+    staged: Dict[str, StagedFn] = {}
+
+    def params_of(fn: ast.FunctionDef) -> List[str]:
+        a = fn.args
+        return [x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+    # decorated defs
+    for name, fn in defs.items():
+        for dec in fn.decorator_list:
+            is_jit, statics = _is_jit_expr(dec)
+            if is_jit:
+                meta = _jit_call_meta(dec)
+                donated = (
+                    _donate_kwargs(meta, params_of(fn)) if meta else ()
+                )
+                staged[name] = StagedFn(
+                    name=name, node=fn, statics=statics, donated=donated,
+                    factory=parent.get(id(fn)), public_name=name,
+                )
+    # wrapped: x = jax.jit(f, ...) | x = functools.partial(jax.jit, ...)(f)
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        is_jit, statics = _is_jit_expr(call.func)
+        if not is_jit:
+            continue
+        meta = _jit_call_meta(call.func) or call
+        if dotted_name(call.func) in ("jax.jit", "jit"):
+            statics = _static_argnames(call)
+            meta = call
+        for arg in call.args:
+            target = dotted_name(arg)
+            if target in defs and target not in staged:
+                fn = defs[target]
+                public = ""
+                if node.targets and isinstance(node.targets[0], ast.Name):
+                    public = node.targets[0].id
+                staged[target] = StagedFn(
+                    name=target, node=fn, statics=statics,
+                    donated=_donate_kwargs(meta, params_of(fn)),
+                    factory=parent.get(id(fn)), public_name=public or target,
+                )
+    # shard_mapped, possibly wrapped in jax.jit(shard_map(f,...), donate=...)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if dotted_name(node.func) not in SHARD_MAP_CALLEES:
+            continue
+        target = dotted_name(node.args[0])
+        if target not in defs or target in staged:
+            continue
+        fn = defs[target]
+        staged[target] = StagedFn(
+            name=target, node=fn, kind="shard_map",
+            factory=parent.get(id(fn)), public_name=target,
+        )
+    # donation attached to the jit wrapping a shard_map call
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) not in ("jax.jit", "jit") or not node.args:
+            continue
+        inner = node.args[0]
+        if not isinstance(inner, ast.Call):
+            continue
+        if dotted_name(inner.func) not in SHARD_MAP_CALLEES or not inner.args:
+            continue
+        target = dotted_name(inner.args[0])
+        rec = staged.get(target)
+        if rec is not None and rec.kind == "shard_map":
+            rec.donated = _donate_kwargs(node, rec.params)
+    return [staged[k] for k in sorted(staged)]
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter
+# ---------------------------------------------------------------------------
+
+_MAX_DEPTH = 8
+
+
+class _Interp:
+    def __init__(self, sf: SourceFile, staged: StagedFn,
+                 contract: Optional[Contract],
+                 module_defs: Dict[str, ast.FunctionDef],
+                 findings: List[Finding]) -> None:
+        self.sf = sf
+        self.staged = staged
+        self.contract = contract
+        self.mesh: Set[str] = set(contract.mesh) if contract else set()
+        self.module_defs = module_defs
+        self.findings = findings
+        self._returns_stack: List[List[object]] = []
+        self._active: Set[str] = set()
+        self._depth = 0
+
+    # -- findings ---------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", self.staged.node.lineno)
+        if rule == RULE_RETRACE and self.sf.has_waiver(line, RETRACE_WAIVER):
+            return
+        if self.sf.has_waiver(line, WAIVER):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.sf.path, line=line, message=message,
+            symbol=self.staged.name,
+        ))
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> None:
+        env: Dict[str, object] = {}
+        statics = set(self.staged.statics)
+        if self.contract:
+            statics |= set(self.contract.statics)
+            for name, v in self.contract.args.items():
+                env[name] = v
+        for p in self.staged.params:
+            env.setdefault(
+                p, AbsVal(rank=0) if p in statics else UNKNOWN
+            )
+        self._returns_stack.append([])
+        self._active.add(self.staged.name)
+        try:
+            self._exec_block(self.staged.node.body, env)
+        finally:
+            self._active.discard(self.staged.name)
+            self._returns_stack.pop()
+
+    # -- statements -------------------------------------------------------
+
+    def _exec_block(self, stmts: List[ast.stmt], env: Dict[str, object]) -> None:
+        for s in stmts:
+            self._exec_stmt(s, env)
+
+    def _bind_target(self, target: ast.expr, value: object,
+                     env: Dict[str, object]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(value, tuple) and len(value) == len(
+                [e for e in elts if not isinstance(e, ast.Starred)]
+            ) and not any(isinstance(e, ast.Starred) for e in elts):
+                for e, v in zip(elts, value):
+                    self._bind_target(e, v, env)
+            else:
+                for e in elts:
+                    if isinstance(e, ast.Starred):
+                        e = e.value
+                    self._bind_target(e, UNKNOWN, env)
+        # subscript/attribute stores don't rebind abstract names
+
+    def _exec_stmt(self, s: ast.stmt, env: Dict[str, object]) -> None:
+        if isinstance(s, ast.FunctionDef):
+            env[s.name] = FuncVal(s, dict(env))
+        elif isinstance(s, ast.Assign):
+            v = self.eval(s.value, env)
+            for t in s.targets:
+                self._bind_target(t, v, env)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._bind_target(s.target, self.eval(s.value, env), env)
+        elif isinstance(s, ast.AugAssign):
+            v = self.eval(s.value, env)
+            if isinstance(s.target, ast.Name):
+                cur = env.get(s.target.id, UNKNOWN)
+                env[s.target.id] = self._binop_value(s.op, cur, v, s)
+        elif isinstance(s, ast.Return):
+            val = self.eval(s.value, env) if s.value is not None else UNKNOWN
+            if self._returns_stack:
+                self._returns_stack[-1].append(val)
+        elif isinstance(s, ast.If):
+            self._exec_if(s, env)
+        elif isinstance(s, (ast.For, ast.While)):
+            # static Python loop: one abstract pass, then join with entry
+            if isinstance(s, ast.For):
+                self.eval(s.iter, env)
+                self._bind_target(s.target, UNKNOWN, env)
+            else:
+                self.eval(s.test, env)
+            snap = dict(env)
+            self._exec_block(s.body, env)
+            self._exec_block(s.orelse, env)
+            for k in list(env):
+                env[k] = _join_static(env[k], snap.get(k, UNKNOWN))
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                self.eval(item.context_expr, env)
+            self._exec_block(s.body, env)
+        elif isinstance(s, ast.Expr):
+            self.eval(s.value, env)
+        elif isinstance(s, (ast.Assert,)):
+            self.eval(s.test, env)
+        elif isinstance(s, ast.Try):
+            self._exec_block(s.body, env)
+            for h in s.handlers:
+                self._exec_block(h.body, env)
+            self._exec_block(s.orelse, env)
+            self._exec_block(s.finalbody, env)
+        # Pass / Raise / Import / Global / Delete: no abstract effect
+
+    def _exec_if(self, s: ast.If, env: Dict[str, object]) -> None:
+        self.eval(s.test, env)
+        is_layout_fork = (
+            isinstance(s.test, ast.Name) and s.test.id in LAYOUT_FLAG_NAMES
+        )
+        env_t = _refine_layout(env, "packed") if is_layout_fork else dict(env)
+        env_f = _refine_layout(env, "wide") if is_layout_fork else dict(env)
+        self._exec_block(s.body, env_t)
+        self._exec_block(s.orelse, env_f)
+        for k in set(env_t) | set(env_f):
+            env[k] = _join_static(env_t.get(k, UNKNOWN), env_f.get(k, UNKNOWN))
+
+    # -- expressions ------------------------------------------------------
+
+    def eval(self, node: Optional[ast.expr], env: Dict[str, object]) -> object:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return AbsVal("bool", 0)
+            if isinstance(v, int):
+                return AbsVal("i32", 0)
+            if isinstance(v, float):
+                return AbsVal("f32", 0)
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.eval(e, env) for e in node.elts)
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.BinOp):
+            a = self.eval(node.left, env)
+            b = self.eval(node.right, env)
+            return self._binop_value(node.op, a, b, node)
+        if isinstance(node, ast.BoolOp):
+            vals = [self.eval(v, env) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = _join_static(out, v)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env)
+            if isinstance(v, AbsVal):
+                return v
+            return UNKNOWN
+        if isinstance(node, ast.Compare):
+            ops = [self.eval(node.left, env)] + [
+                self.eval(c, env) for c in node.comparators
+            ]
+            lay = _known_layout(*ops)
+            rank = None
+            ranks = [o.rank for o in ops if isinstance(o, AbsVal)
+                     and o.rank is not None]
+            if len(ranks) == len(ops):
+                rank = max(ranks)
+            return AbsVal("bool", rank, lay if lay == "wide" else None)
+        if isinstance(node, ast.IfExp):
+            is_layout_fork = (
+                isinstance(node.test, ast.Name)
+                and node.test.id in LAYOUT_FLAG_NAMES
+            )
+            self.eval(node.test, env)
+            if is_layout_fork:
+                a = self.eval(node.body, _refine_layout(env, "packed"))
+                b = self.eval(node.orelse, _refine_layout(env, "wide"))
+            else:
+                a = self.eval(node.body, env)
+                b = self.eval(node.orelse, env)
+            return _join_static(a, b)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value, env)
+            if node.attr == "shape":
+                return AbsVal("i32", 1)
+            if node.attr == "T" and isinstance(base, AbsVal):
+                return base
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Lambda):
+            return UNKNOWN
+        return UNKNOWN
+
+    def _binop_value(self, op: ast.operator, a: object, b: object,
+                     node: ast.AST) -> object:
+        if not isinstance(a, AbsVal) or not isinstance(b, AbsVal):
+            return UNKNOWN
+        if isinstance(op, ast.MatMult):
+            for v in (a, b):
+                if v.layout == "packed":
+                    self._emit(RULE_LAYOUT, node,
+                               "packed uint32 word table used as a matmul "
+                               "operand — unpack (tpu/packed.py unpack_bits) "
+                               "or tally with packed_tally/popcount_sum")
+            return UNKNOWN
+        if _layout_conflict(a, b):
+            self._emit(RULE_LAYOUT, node,
+                       "binary op mixes a packed uint32 word table with a "
+                       "wide table — the operands live in different lane "
+                       "layouts; pack/unpack one side explicitly")
+            return UNKNOWN
+        lay = a.layout if a.layout == b.layout else (a.layout or b.layout)
+        if lay == "dual" and (a.layout != "dual" or b.layout != "dual"):
+            lay = "dual"
+        dt = _join_field(a.dtype, b.dtype)
+        if isinstance(op, ast.Div):
+            dt = "f32"
+        rank = None
+        if a.rank is not None and b.rank is not None:
+            rank = max(a.rank, b.rank)
+        return AbsVal(dt, rank, lay)
+
+    def _subscript(self, node: ast.Subscript, env: Dict[str, object]) -> object:
+        base = self.eval(node.value, env)
+        sl = node.slice
+        if isinstance(base, tuple):
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+                i = sl.value
+                if -len(base) <= i < len(base):
+                    return base[i]
+            if isinstance(sl, ast.UnaryOp) and isinstance(sl.op, ast.USub) \
+                    and isinstance(sl.operand, ast.Constant) \
+                    and isinstance(sl.operand.value, int):
+                i = -sl.operand.value
+                if -len(base) <= i < len(base):
+                    return base[i]
+            return UNKNOWN
+        if not isinstance(base, AbsVal):
+            return UNKNOWN
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        delta = 0
+        exact = True
+        for e in elts:
+            self.eval(e, env)
+            if isinstance(e, ast.Slice):
+                continue
+            if isinstance(e, ast.Constant) and e.value is None:
+                delta += 1
+            elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+                delta -= 1
+            elif isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.USub):
+                delta -= 1
+            else:
+                exact = False  # gather / advanced indexing: rank unknown
+        rank = base.rank + delta if (exact and base.rank is not None) else None
+        return AbsVal(base.dtype, rank, base.layout)
+
+    # -- calls ------------------------------------------------------------
+
+    def _arg_vals(self, node: ast.Call, env: Dict[str, object]
+                  ) -> Tuple[List[object], Dict[str, object]]:
+        args = [self.eval(a, env) for a in node.args]
+        kwargs = {
+            kw.arg: self.eval(kw.value, env)
+            for kw in node.keywords if kw.arg is not None
+        }
+        return args, kwargs
+
+    def _dtype_kw(self, node: ast.Call) -> Optional[str]:
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                nm = dotted_name(kw.value)
+                if nm:
+                    return _DTYPE_TAILS.get(nm.rsplit(".", 1)[-1])
+        return None
+
+    def _check_axis_operand(self, expr: Optional[ast.expr],
+                            node: ast.Call, opname: str) -> None:
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                self._check_axis_operand(e, node, opname)
+            return
+        if isinstance(expr, ast.Starred):
+            self._check_axis_operand(expr.value, node, opname)
+            return
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return
+            if isinstance(expr.value, str):
+                name = expr.value
+        if name is None:
+            return  # dynamic axis expressions are out of lexical scope
+        if not self.mesh:
+            self._emit(RULE_MESH, node,
+                       f"{opname} over axis {name!r} in a staged function "
+                       "whose kernel-contract declares no `mesh:` axes — "
+                       "collectives need a declared mesh residency")
+        elif name not in self.mesh:
+            self._emit(RULE_MESH, node,
+                       f"{opname} names axis {name!r}, absent from the "
+                       "contract's mesh axes "
+                       f"{{{', '.join(sorted(self.mesh))}}}")
+
+    def _call_local(self, fn: ast.FunctionDef, closure: Dict[str, object],
+                    args: List[object], kwargs: Dict[str, object],
+                    call_kw_names: Optional[Set[str]] = None) -> object:
+        if fn.name in self._active or self._depth >= _MAX_DEPTH:
+            return UNKNOWN
+        a = fn.args
+        params = [x.arg for x in (*a.posonlyargs, *a.args)]
+        env = dict(closure)
+        for p, v in zip(params, args):
+            env[p] = v
+        for k, v in kwargs.items():
+            if call_kw_names is None or k in call_kw_names or True:
+                env[k] = v
+        for p in params + [x.arg for x in a.kwonlyargs]:
+            env.setdefault(p, UNKNOWN)
+        self._active.add(fn.name)
+        self._depth += 1
+        self._returns_stack.append([])
+        try:
+            self._exec_block(fn.body, env)
+        finally:
+            rets = self._returns_stack.pop()
+            self._depth -= 1
+            self._active.discard(fn.name)
+        if not rets:
+            return UNKNOWN
+        out = rets[0]
+        for r in rets[1:]:
+            out = _join_static(out, r)
+        return out
+
+    def _resolve_func(self, expr: ast.expr, env: Dict[str, object]
+                      ) -> Optional[Tuple[ast.FunctionDef, Dict[str, object]]]:
+        if isinstance(expr, ast.Name):
+            v = env.get(expr.id)
+            if isinstance(v, FuncVal):
+                return v.node, v.closure
+            fn = self.module_defs.get(expr.id)
+            if fn is not None:
+                return fn, {}
+        return None
+
+    def _carry(self, node: ast.Call, env: Dict[str, object],
+               kind: str) -> object:
+        """scan/while/fori carry analysis: interpret the body with the
+        init carry bound, compare init vs the body's returned carry."""
+        args = node.args
+        kwmap = {kw.arg: kw.value for kw in node.keywords}
+        if kind == "scan":
+            fn_e = args[0] if args else kwmap.get("f")
+            init_e = args[1] if len(args) > 1 else kwmap.get("init")
+        elif kind == "while":
+            fn_e = args[1] if len(args) > 1 else kwmap.get("body_fun")
+            init_e = args[2] if len(args) > 2 else kwmap.get("init_val")
+            if args:
+                cond = self._resolve_func(args[0], env)
+                if cond is not None:
+                    self._call_local(cond[0], cond[1],
+                                     [self.eval(init_e, env)], {})
+        else:  # fori
+            fn_e = args[2] if len(args) > 2 else kwmap.get("body_fun")
+            init_e = args[3] if len(args) > 3 else kwmap.get("init_val")
+        init = self.eval(init_e, env) if init_e is not None else UNKNOWN
+        resolved = self._resolve_func(fn_e, env) if fn_e is not None else None
+        if resolved is None:
+            return (init, UNKNOWN) if kind == "scan" else init
+        fn, closure = resolved
+        if kind == "scan":
+            ret = self._call_local(fn, closure, [init, UNKNOWN], {})
+            carry_ret = ret[0] if isinstance(ret, tuple) and len(ret) == 2 \
+                else UNKNOWN
+        elif kind == "while":
+            ret = self._call_local(fn, closure, [init], {})
+            carry_ret = ret
+        else:
+            ret = self._call_local(fn, closure, [AbsVal("i32", 0), init], {})
+            carry_ret = ret
+        self._compare_carry(init, carry_ret, node, kind)
+        joined = _join_traced(init, carry_ret)
+        return (joined, UNKNOWN) if kind == "scan" else joined
+
+    def _compare_carry(self, init: object, ret: object, node: ast.AST,
+                       kind: str, path: str = "carry") -> None:
+        if isinstance(init, tuple) or isinstance(ret, tuple):
+            if not (isinstance(init, tuple) and isinstance(ret, tuple)):
+                return  # one side unknown: nothing proven
+            if len(init) != len(ret):
+                self._emit(RULE_CARRY, node,
+                           f"lax.{kind} {path} arity drifts: init has "
+                           f"{len(init)} element(s), the body returns "
+                           f"{len(ret)}")
+                return
+            for i, (a, b) in enumerate(zip(init, ret)):
+                self._compare_carry(a, b, node, kind, f"{path}[{i}]")
+            return
+        if not isinstance(init, AbsVal) or not isinstance(ret, AbsVal):
+            return
+        if init.dtype and ret.dtype and init.dtype != ret.dtype:
+            self._emit(RULE_CARRY, node,
+                       f"lax.{kind} {path} dtype drifts between init "
+                       f"({init.dtype}) and the body's return ({ret.dtype})")
+        if init.rank is not None and ret.rank is not None \
+                and init.rank != ret.rank:
+            self._emit(RULE_CARRY, node,
+                       f"lax.{kind} {path} rank drifts between init "
+                       f"(rank {init.rank}) and the body's return "
+                       f"(rank {ret.rank})")
+        if {init.layout, ret.layout} == {"wide", "packed"}:
+            self._emit(RULE_CARRY, node,
+                       f"lax.{kind} {path} layout drifts between init "
+                       f"({init.layout}) and the body's return "
+                       f"({ret.layout}) — the carry would re-trace or "
+                       "silently reinterpret word lanes")
+
+    def _call(self, node: ast.Call, env: Dict[str, object]) -> object:
+        func = node.func
+        callee = dotted_name(func)
+        tail = callee.rsplit(".", 1)[-1] if callee else None
+
+        # .at[...].set/min/max/add/mul(...) chains preserve the array
+        if (isinstance(func, ast.Attribute)
+                and func.attr in ("set", "min", "max", "add", "mul", "get")
+                and isinstance(func.value, ast.Subscript)
+                and isinstance(func.value.value, ast.Attribute)
+                and func.value.value.attr == "at"):
+            base = self.eval(func.value.value.value, env)
+            for a in node.args:
+                self.eval(a, env)
+            if isinstance(base, AbsVal):
+                if func.attr == "get":
+                    return AbsVal(base.dtype, None, base.layout)
+                return base
+            return UNKNOWN
+
+        if isinstance(func, ast.Attribute) and func.attr == "astype":
+            recv = self.eval(func.value, env)
+            dt = None
+            if node.args:
+                nm = dotted_name(node.args[0])
+                if nm:
+                    dt = _DTYPE_TAILS.get(nm.rsplit(".", 1)[-1])
+            if isinstance(recv, AbsVal):
+                if dt == "f32" and recv.layout == "packed":
+                    self._emit(RULE_LAYOUT, node,
+                               "packed uint32 word table cast to float — "
+                               "word values are bit patterns, not counts; "
+                               "unpack or popcount first")
+                return AbsVal(dt, recv.rank, recv.layout)
+            return AbsVal(dt, None, None)
+
+        if isinstance(func, ast.Attribute) and func.attr == "_replace":
+            recv = self.eval(func.value, env)
+            for kw in node.keywords:
+                self.eval(kw.value, env)
+            return recv
+
+        if isinstance(func, ast.Attribute) and func.attr == "reshape":
+            recv = self.eval(func.value, env)
+            rank = None
+            if len(node.args) == 1 and isinstance(node.args[0], ast.Tuple):
+                rank = len(node.args[0].elts)
+            elif node.args:
+                rank = len(node.args)
+            if isinstance(recv, AbsVal):
+                return AbsVal(recv.dtype, rank, recv.layout)
+            return UNKNOWN
+
+        args, kwargs = self._arg_vals(node, env)
+        a0 = args[0] if args else UNKNOWN
+
+        if tail in ("pack_bits", "pack_votes_t"):
+            if isinstance(a0, AbsVal) and a0.layout == "packed":
+                self._emit(RULE_LAYOUT, node,
+                           f"{tail}() applied to an already-packed table — "
+                           "double packing reinterprets word lanes as bits")
+            rank = a0.rank if isinstance(a0, AbsVal) else None
+            return AbsVal("u32", rank, "packed")
+        if tail == "unpack_bits":
+            rank = a0.rank if isinstance(a0, AbsVal) else None
+            return AbsVal("bool", rank, "wide")
+        if tail in ("popcount_sum", "packed_tally"):
+            for v in args:
+                if isinstance(v, AbsVal) and v.layout == "wide":
+                    self._emit(RULE_LAYOUT, node,
+                               f"wide table passed to {tail}() — popcount "
+                               "tallies are defined on packed uint32 words "
+                               "(pack_bits/pack_votes_t first)")
+            rank = a0.rank - 1 if (isinstance(a0, AbsVal)
+                                   and a0.rank is not None
+                                   and tail == "popcount_sum") else None
+            return AbsVal("i32", rank, None)
+        if tail == "packed_count":
+            if isinstance(a0, AbsVal) and a0.layout == "packed":
+                self._emit(RULE_LAYOUT, node,
+                           "packed_count() packs internally; passing an "
+                           "already-packed table double-packs it")
+            rank = a0.rank - 1 if (isinstance(a0, AbsVal)
+                                   and a0.rank is not None) else None
+            return AbsVal("i32", rank, None)
+        if tail == "population_count":
+            if isinstance(a0, AbsVal) and a0.layout == "wide":
+                self._emit(RULE_LAYOUT, node,
+                           "population_count() on a wide table — per-element "
+                           "popcounts of bool/int lanes are not a tally; "
+                           "pack into uint32 words first")
+            if isinstance(a0, AbsVal):
+                return AbsVal(a0.dtype, a0.rank, None)
+            return UNKNOWN
+        if tail in _MATMUL_TAILS:
+            for v in args:
+                if isinstance(v, AbsVal) and v.layout == "packed":
+                    self._emit(RULE_LAYOUT, node,
+                               f"packed uint32 word table reaches {tail}() — "
+                               "MXU contractions read lane words as numbers; "
+                               "unpack_bits or use packed_tally")
+            return UNKNOWN
+        if tail in _FLOAT_CTORS:
+            if isinstance(a0, AbsVal) and a0.layout == "packed":
+                self._emit(RULE_LAYOUT, node,
+                           f"{tail}() on a packed uint32 word table — "
+                           "word values are bit patterns, not numbers")
+            rank = a0.rank if isinstance(a0, AbsVal) else None
+            return AbsVal("f32", rank, None)
+        if tail in ("int32", "int64", "int16", "int8"):
+            rank = a0.rank if isinstance(a0, AbsVal) else (0 if args else None)
+            lay = a0.layout if isinstance(a0, AbsVal) else None
+            return AbsVal("i32", rank, lay)
+        if tail in ("uint32", "uint64", "uint8"):
+            rank = a0.rank if isinstance(a0, AbsVal) else (0 if args else None)
+            lay = a0.layout if isinstance(a0, AbsVal) else None
+            return AbsVal("u32", rank, lay)
+        if tail == "bool_":
+            rank = a0.rank if isinstance(a0, AbsVal) else (0 if args else None)
+            lay = a0.layout if isinstance(a0, AbsVal) else None
+            return AbsVal("bool", rank, lay)
+
+        if tail in ("zeros", "ones", "empty", "full"):
+            dt = self._dtype_kw(node)
+            if dt is None and tail == "full" and len(args) > 1 \
+                    and isinstance(args[1], AbsVal):
+                dt = args[1].dtype
+            if dt is None and node.args:
+                # zeros((n, m), bool) positional dtype
+                for a in node.args[1:]:
+                    nm = dotted_name(a)
+                    if nm and nm.rsplit(".", 1)[-1] in _DTYPE_TAILS:
+                        dt = _DTYPE_TAILS[nm.rsplit(".", 1)[-1]]
+            rank = None
+            if node.args:
+                shp = node.args[0]
+                if isinstance(shp, (ast.Tuple, ast.List)):
+                    rank = len(shp.elts)
+                elif isinstance(shp, (ast.Name, ast.Constant, ast.BinOp,
+                                      ast.Attribute, ast.Subscript)):
+                    rank = 1
+            return AbsVal(dt, rank, None)
+        if tail in ("zeros_like", "ones_like", "full_like", "empty_like"):
+            if isinstance(a0, AbsVal):
+                dt = self._dtype_kw(node) or a0.dtype
+                return AbsVal(dt, a0.rank, a0.layout)
+            return UNKNOWN
+        if tail == "arange":
+            return AbsVal(self._dtype_kw(node) or "i32", 1, None)
+
+        if tail in ("where", "select"):
+            if len(args) == 3:
+                if _layout_conflict(args[1], args[2]):
+                    self._emit(RULE_LAYOUT, node,
+                               f"jnp.{tail}() joins a packed uint32 word "
+                               "table with a wide table — the branches live "
+                               "in different lane layouts")
+                return _join_traced(args[1], args[2])
+            return UNKNOWN
+        if tail in ("concatenate", "stack", "hstack", "vstack"):
+            elems: List[object] = []
+            if node.args and isinstance(node.args[0], (ast.Tuple, ast.List)):
+                elems = [self.eval(e, env) for e in node.args[0].elts]
+            if elems:
+                out = elems[0]
+                for e in elems[1:]:
+                    if _layout_conflict(out, e):
+                        self._emit(RULE_LAYOUT, node,
+                                   f"jnp.{tail}() concatenates a packed "
+                                   "uint32 word table with a wide table")
+                    out = _join_traced(out, e)
+                if tail == "stack" and isinstance(out, AbsVal) \
+                        and out.rank is not None:
+                    out = _with(out, rank=out.rank + 1)
+                return out
+            return UNKNOWN
+
+        if tail in ("roll", "flip", "sort", "clip", "abs", "mod",
+                    "cumsum", "cummax", "cummin", "pad", "tile",
+                    "dynamic_slice", "dynamic_update_slice",
+                    "dynamic_slice_in_dim", "dynamic_update_slice_in_dim",
+                    "swapaxes", "transpose", "rev", "stop_gradient"):
+            if isinstance(a0, AbsVal):
+                return AbsVal(a0.dtype, a0.rank, a0.layout)
+            return UNKNOWN
+        if tail in ("maximum", "minimum", "power"):
+            if len(args) >= 2:
+                if _layout_conflict(args[0], args[1]):
+                    self._emit(RULE_LAYOUT, node,
+                               f"jnp.{tail}() mixes packed and wide tables")
+                return _join_traced(args[0], args[1])
+            return a0 if isinstance(a0, AbsVal) else UNKNOWN
+        if tail in ("expand_dims",):
+            if isinstance(a0, AbsVal) and a0.rank is not None:
+                return _with(a0, rank=a0.rank + 1)
+            return a0 if isinstance(a0, AbsVal) else UNKNOWN
+        if tail in ("squeeze",):
+            if isinstance(a0, AbsVal):
+                return AbsVal(a0.dtype, None, a0.layout)
+            return UNKNOWN
+        if tail == "broadcast_to":
+            rank = None
+            if len(node.args) > 1 and isinstance(node.args[1],
+                                                 (ast.Tuple, ast.List)):
+                rank = len(node.args[1].elts)
+            if isinstance(a0, AbsVal):
+                return AbsVal(a0.dtype, rank, a0.layout)
+            return UNKNOWN
+        if tail in ("sum", "prod", "mean"):
+            if isinstance(a0, AbsVal) and a0.layout == "packed":
+                self._emit(RULE_LAYOUT, node,
+                           f"jnp.{tail}() over a packed uint32 word table "
+                           "sums raw lane words — use popcount_sum for "
+                           "membership tallies")
+            dt = self._dtype_kw(node)
+            if dt is None and isinstance(a0, AbsVal):
+                dt = "i32" if a0.dtype == "bool" else a0.dtype
+            return AbsVal(dt, None, None)
+        if tail in ("any", "all"):
+            return AbsVal("bool", None, None)
+        if tail in ("max", "min", "argmax", "argmin", "argsort",
+                    "searchsorted", "count_nonzero"):
+            dt = "i32" if tail.startswith(("arg", "search", "count")) else (
+                a0.dtype if isinstance(a0, AbsVal) else None
+            )
+            return AbsVal(dt, None, None)
+
+        if tail in ("psum", "pmax", "pmin", "psum_scatter"):
+            axis_e = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "axis_name"), None
+            )
+            self._check_axis_operand(axis_e, node, f"lax.{tail}")
+            return a0 if isinstance(a0, AbsVal) else UNKNOWN
+        if tail == "ppermute":
+            axis_e = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "axis_name"), None
+            )
+            self._check_axis_operand(axis_e, node, "lax.ppermute")
+            return a0 if isinstance(a0, AbsVal) else UNKNOWN
+        if tail == "all_gather":
+            axis_e = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "axis_name"), None
+            )
+            self._check_axis_operand(axis_e, node, "lax.all_gather")
+            if isinstance(a0, AbsVal):
+                return AbsVal(a0.dtype, None, a0.layout)
+            return UNKNOWN
+        if tail == "axis_index":
+            axis_e = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "axis_name"), None
+            )
+            self._check_axis_operand(axis_e, node, "lax.axis_index")
+            return AbsVal("i32", 0, None)
+
+        if tail == "scan":
+            return self._carry(node, env, "scan")
+        if tail == "while_loop":
+            return self._carry(node, env, "while")
+        if tail == "fori_loop":
+            return self._carry(node, env, "fori")
+        if tail == "associative_scan":
+            v = args[1] if len(args) > 1 else UNKNOWN
+            return v if isinstance(v, AbsVal) else UNKNOWN
+        if tail == "cond":
+            outs = []
+            for br in node.args[1:3]:
+                r = self._resolve_func(br, env)
+                if r is not None:
+                    outs.append(self._call_local(r[0], r[1], args[3:], {}))
+            if len(outs) == 2:
+                if _layout_conflict(outs[0], outs[1]):
+                    self._emit(RULE_LAYOUT, node,
+                               "lax.cond branches return different table "
+                               "layouts (packed vs wide)")
+                return _join_traced(outs[0], outs[1])
+            return UNKNOWN
+
+        # transitive interpretation of module-local / nested helpers
+        resolved = self._resolve_func(func, env)
+        if resolved is not None:
+            return self._call_local(resolved[0], resolved[1], args, kwargs)
+        return UNKNOWN
+
+
+# ---------------------------------------------------------------------------
+# donation analysis (lexical use-after-donate + carried-loop audit)
+# ---------------------------------------------------------------------------
+
+
+def _factory_donations(sf: SourceFile) -> Dict[str, Tuple[int, ...]]:
+    """{factory function name: donated positional indices} for module
+    functions whose return value is `jax.jit(..., donate_argnums=...)` —
+    the tpu/sharded.py lru_cached shard_map factory idiom."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in sf.tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for ret in ast.walk(node):
+            if not isinstance(ret, ast.Return) or ret.value is None:
+                continue
+            call = ret.value
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted_name(call.func) not in ("jax.jit", "jit"):
+                continue
+            nums: List[int] = []
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    v = kw.value
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                        nums = [v.value]
+                    elif isinstance(v, (ast.Tuple, ast.List)):
+                        nums = [
+                            e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)
+                        ]
+            if nums:
+                out[node.name] = tuple(nums)
+    return out
+
+
+@dataclass
+class _DonatingCallable:
+    positions: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    params: Tuple[str, ...] = ()  # full positional param list, if known
+
+    def donated_args(self, call: ast.Call) -> List[ast.expr]:
+        # positions and argnames may resolve to the same argument node
+        # (StagedFn.donated carries names for donate_argnums too); dedupe
+        # by node identity so one donated buffer yields one event
+        out: List[ast.expr] = []
+        seen: Set[int] = set()
+
+        def add(e: ast.expr) -> None:
+            if id(e) not in seen:
+                seen.add(id(e))
+                out.append(e)
+
+        for i in self.positions:
+            if i < len(call.args):
+                add(call.args[i])
+        names = set(self.argnames)
+        if names and self.params:
+            for i, p in enumerate(self.params):
+                if p in names and i < len(call.args):
+                    add(call.args[i])
+        for kw in call.keywords:
+            if kw.arg in names:
+                add(kw.value)
+        return out
+
+
+def _donating_callables(sf: SourceFile, staged: List[StagedFn]
+                        ) -> Dict[str, _DonatingCallable]:
+    table: Dict[str, _DonatingCallable] = {}
+    for rec in staged:
+        if not rec.donated:
+            continue
+        params = tuple(rec.params)
+        positions = tuple(
+            i for i, p in enumerate(params) if p in set(rec.donated)
+        )
+        dc = _DonatingCallable(positions=positions, argnames=rec.donated,
+                               params=params)
+        table[rec.name] = dc
+        if rec.public_name and rec.public_name != rec.name:
+            table[rec.public_name] = dc
+    return table
+
+
+def _staged_callables(staged: List[StagedFn]) -> Dict[str, StagedFn]:
+    out: Dict[str, StagedFn] = {}
+    for rec in staged:
+        out[rec.name] = rec
+        if rec.public_name:
+            out.setdefault(rec.public_name, rec)
+    return out
+
+
+class _NameEvents(ast.NodeVisitor):
+    """Loads and stores of plain names within one function body, with
+    source position, excluding nested function bodies and an excluded
+    subtree (the donating call's own argument list)."""
+
+    def __init__(self) -> None:
+        self.loads: List[Tuple[int, int, str, ast.AST]] = []
+        self.stores: List[Tuple[int, str]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:  # noqa: N802
+        pass  # nested defs have their own event streams
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Name(self, node: ast.Name) -> None:  # noqa: N802
+        if isinstance(node.ctx, ast.Load):
+            self.loads.append((node.lineno, node.col_offset, node.id, node))
+        elif isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.stores.append((node.lineno, node.id))
+        self.generic_visit(node)
+
+
+def check_donation(sf: SourceFile, staged: List[StagedFn]
+                   ) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    donating = _donating_callables(sf, staged)
+    factories = _factory_donations(sf)
+    staged_by_name = _staged_callables(staged)
+
+    def resolve_donating(call: ast.Call,
+                         aliases: Dict[str, _DonatingCallable]
+                         ) -> Optional[_DonatingCallable]:
+        nm = dotted_name(call.func)
+        tail = nm.rsplit(".", 1)[-1] if nm else None
+        if tail in donating:
+            return donating[tail]
+        if tail in aliases:
+            return aliases[tail]
+        # factory(...)(args): the inner call names a donating factory
+        if isinstance(call.func, ast.Call):
+            inner = dotted_name(call.func.func)
+            itail = inner.rsplit(".", 1)[-1] if inner else None
+            if itail in factories:
+                return _DonatingCallable(positions=factories[itail])
+        return None
+
+    for fn in [n for n in ast.walk(sf.tree)
+               if isinstance(n, ast.FunctionDef)]:
+        # local aliases bound from donating factories: f = _factory(...)
+        aliases: Dict[str, _DonatingCallable] = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                nm = dotted_name(stmt.value.func)
+                tail = nm.rsplit(".", 1)[-1] if nm else None
+                if tail in factories:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            aliases[t.id] = _DonatingCallable(
+                                positions=factories[tail]
+                            )
+
+        ev = _NameEvents()
+        for stmt in fn.body:
+            ev.visit(stmt)
+
+        # use-after-donate: a donated plain-Name buffer loaded after the
+        # donating call, with no intervening rebind
+        donate_events: List[Tuple[int, str, ast.Call]] = []
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            dc = resolve_donating(sub, aliases)
+            if dc is None:
+                continue
+            for arg in dc.donated_args(sub):
+                if isinstance(arg, ast.Name):
+                    donate_events.append((sub.lineno, arg.id, sub))
+        for dline, name, call in donate_events:
+            call_nodes = set(map(id, ast.walk(call)))
+            for lline, _col, lname, lnode in ev.loads:
+                if lname != name or lline <= dline:
+                    continue
+                if id(lnode) in call_nodes:
+                    continue
+                rebound = any(
+                    dline <= sline <= lline and sname == name
+                    for sline, sname in ev.stores
+                )
+                if rebound:
+                    continue
+                if sf.has_waiver(lline, WAIVER):
+                    break
+                findings.append(Finding(
+                    rule=RULE_DONATE, path=sf.path, line=lline,
+                    message=(
+                        f"{name!r} is donated to the staged call at line "
+                        f"{dline} (donate_argnums/argnames) but read again "
+                        "here — the buffer may have been overwritten in "
+                        "place; copy before donating or drop the donation"
+                    ),
+                    symbol=fn.name,
+                ))
+                break  # one finding per donated buffer is enough
+
+        # carried-loop donation: x = staged(x, ...) inside a host loop
+        # where x's parameter is not donated double-buffers every pass
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for stmt in ast.walk(loop):
+                if not isinstance(stmt, ast.Assign) \
+                        or not isinstance(stmt.value, ast.Call):
+                    continue
+                call = stmt.value
+                nm = dotted_name(call.func)
+                tail = nm.rsplit(".", 1)[-1] if nm else None
+                rec = staged_by_name.get(tail) if tail else None
+                if rec is None:
+                    continue
+                target_names = {
+                    t.id for t in stmt.targets if isinstance(t, ast.Name)
+                } | {
+                    e.id
+                    for t in stmt.targets
+                    if isinstance(t, (ast.Tuple, ast.List))
+                    for e in t.elts if isinstance(e, ast.Name)
+                }
+                if not target_names:
+                    continue
+                params = rec.params
+                donated = set(rec.donated)
+                statics = set(rec.statics)
+                for i, arg in enumerate(call.args):
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id not in target_names or i >= len(params):
+                        continue
+                    p = params[i]
+                    if p in donated or p in statics:
+                        continue
+                    if sf.has_waiver(call.lineno, WAIVER):
+                        continue
+                    findings.append(Finding(
+                        rule=RULE_DONATE, path=sf.path, line=call.lineno,
+                        message=(
+                            f"carried loop buffer {arg.id!r} is passed to "
+                            f"staged {tail!r} (parameter {p!r}) and rebound "
+                            "from its result each iteration but the "
+                            "parameter is not donated — the working set "
+                            "double-buffers every pass; add donate_argnums/"
+                            "donate_argnames (or waive kernel-ok with the "
+                            "retry-loop reason)"
+                        ),
+                        symbol=fn.name,
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# per-file checker
+# ---------------------------------------------------------------------------
+
+
+def _is_lru_cached(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        nm = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+        if nm and nm.rsplit(".", 1)[-1] in _LRU_TAILS:
+            return True
+    return False
+
+
+def _check_partition_specs(sf: SourceFile, factory: ast.FunctionDef,
+                           mesh: Set[str], symbol: str,
+                           findings: List[Finding]) -> None:
+    def atoms(e: ast.expr) -> Iterable[Tuple[ast.expr, Optional[str]]]:
+        if isinstance(e, ast.Starred):
+            yield from atoms(e.value)
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for x in e.elts:
+                yield from atoms(x)
+        elif isinstance(e, ast.Name):
+            yield e, e.id
+        elif isinstance(e, ast.Constant):
+            yield e, (e.value if isinstance(e.value, str) else None)
+        else:
+            yield e, None
+
+    for node in ast.walk(factory):
+        if not isinstance(node, ast.Call):
+            continue
+        nm = dotted_name(node.func)
+        if nm is None or nm.rsplit(".", 1)[-1] not in ("P", "PartitionSpec"):
+            continue
+        for arg in node.args:
+            for _e, name in atoms(arg):
+                if name is None or name in mesh:
+                    continue
+                if sf.has_waiver(node.lineno, WAIVER):
+                    continue
+                findings.append(Finding(
+                    rule=RULE_MESH, path=sf.path, line=node.lineno,
+                    message=(
+                        f"PartitionSpec names axis {name!r}, absent from "
+                        "the mesh axes declared by this factory's "
+                        f"kernel-contract(s) {{{', '.join(sorted(mesh))}}}"
+                    ),
+                    symbol=symbol,
+                ))
+
+    # IfExp specs like `P(a) if packed else P(b)` are walked above; mesh
+    # conditionals introduce no extra forms in this repo.
+
+
+def check_staged(sf: SourceFile) -> Iterable[Finding]:
+    """The kernel-contract pass for one file in the staging scope."""
+    findings: List[Finding] = []
+    staged = find_staged(sf)
+    contracts = parse_contracts(sf)
+    module_defs: Dict[str, ast.FunctionDef] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.FunctionDef):
+            module_defs.setdefault(node.name, node)
+
+    staged_names = {rec.name for rec in staged}
+
+    def emit(rule: str, line: int, message: str, symbol: str,
+             retrace: bool = False) -> None:
+        if retrace and sf.has_waiver(line, RETRACE_WAIVER):
+            return
+        if sf.has_waiver(line, WAIVER):
+            return
+        findings.append(Finding(rule=rule, path=sf.path, line=line,
+                                message=message, symbol=symbol))
+
+    # contracts are annotations: mark their lines used either way — the
+    # findings below own the diagnosis (a stale header is RULE_CONTRACT,
+    # not lint-dead-waiver)
+    for c in contracts.values():
+        for ln in c.lines:
+            sf.mark_waiver_used(ln)
+        for ln, msg in c.malformed:
+            emit(RULE_CONTRACT, ln, msg, c.name)
+        if c.name not in staged_names:
+            emit(RULE_CONTRACT, c.header_line,
+                 f"kernel-contract names {c.name!r}, which is not a "
+                 "jit/shard_map-staged function in this module — stale "
+                 "contract (rename or delete it)", c.name)
+
+    checked_factories: Set[int] = set()
+    factory_mesh: Dict[int, Set[str]] = {}
+    factory_syms: Dict[int, str] = {}
+
+    for rec in staged:
+        c = contracts.get(rec.name)
+        if c is None:
+            emit(RULE_CONTRACT, rec.node.lineno,
+                 f"staged function {rec.name!r} has no `# kernel-contract:` "
+                 "annotation (grammar: docs/analysis.md); every staged "
+                 "entry point must declare its dtype/rank/layout/donation/"
+                 "mesh contract", rec.name)
+            continue
+
+        params = set(rec.params)
+        declared = set(c.args) | set(c.statics)
+        missing = sorted(params - declared)
+        if missing:
+            emit(RULE_CONTRACT, c.header_line,
+                 f"contract for {rec.name!r} does not cover parameter(s) "
+                 f"{missing} — list each under `in:` or `static:`",
+                 rec.name)
+        unknown = sorted(declared - params)
+        if unknown:
+            emit(RULE_CONTRACT, c.header_line,
+                 f"contract for {rec.name!r} declares {unknown}, not "
+                 "parameter(s) of the function — stale names", rec.name)
+
+        # static declarations vs the jit wrapper
+        actual_statics = set(rec.statics)
+        contract_statics = set(c.statics)
+        if rec.kind == "jit":
+            undeclared = sorted(contract_statics - actual_statics)
+            if undeclared:
+                emit(RULE_RETRACE, c.header_line,
+                     f"contract declares {undeclared} static but the jit "
+                     "wrapper's static_argnames omits them — per-call "
+                     "Python values re-trace on every distinct value",
+                     rec.name, retrace=True)
+            unlisted = sorted(actual_statics - contract_statics)
+            if unlisted:
+                emit(RULE_CONTRACT, c.header_line,
+                     f"static_argnames {unlisted} missing from the "
+                     "contract's `static:` line", rec.name)
+        elif contract_statics:
+            emit(RULE_CONTRACT, c.header_line,
+                 "shard_map has no static_argnames channel; drop the "
+                 f"`static:` line from {rec.name!r}'s contract", rec.name)
+
+        # donation declarations vs the wrapper
+        actual_donate = set(rec.donated)
+        contract_donate = set(c.donate)
+        if contract_donate != actual_donate:
+            extra = sorted(contract_donate - actual_donate)
+            lost = sorted(actual_donate - contract_donate)
+            parts = []
+            if extra:
+                parts.append(f"declares {extra} donated but the wrapper "
+                             "does not donate them")
+            if lost:
+                parts.append(f"omits donated parameter(s) {lost}")
+            emit(RULE_DONATE, c.header_line,
+                 f"contract for {rec.name!r} " + " and ".join(parts) +
+                 " — the `donate:` line must match donate_argnums/argnames",
+                 rec.name)
+
+        # retrace: a shard_map/jit factory must be lru_cached or waived
+        if rec.factory is not None and not _is_lru_cached(rec.factory):
+            emit(RULE_RETRACE, rec.node.lineno,
+                 f"staged function {rec.name!r} is built inside "
+                 f"{rec.factory.name!r}, which is not lru_cached — every "
+                 "factory call re-traces and re-compiles (per-call Python "
+                 "closures fragment the executable cache); cache the "
+                 "factory or waive with `# retrace-ok: <reason>`",
+                 rec.name, retrace=True)
+
+        # mesh: collectives in plain-jit functions are checked by the
+        # interpreter; partition specs are checked once per factory
+        if rec.kind == "shard_map" and rec.factory is not None:
+            fid = id(rec.factory)
+            factory_mesh.setdefault(fid, set()).update(c.mesh)
+            factory_syms.setdefault(fid, rec.factory.name)
+            checked_factories.add(fid)
+
+        interp = _Interp(sf, rec, c, module_defs, findings)
+        interp.run()
+
+    for fid in sorted(checked_factories,
+                      key=lambda f: factory_syms.get(f, "")):
+        factory = next(
+            rec.factory for rec in staged
+            if rec.factory is not None and id(rec.factory) == fid
+        )
+        _check_partition_specs(sf, factory, factory_mesh.get(fid, set()),
+                               factory_syms.get(fid, ""), findings)
+
+    findings.extend(check_donation(sf, staged))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# contract table + baseline helpers (docs/tpu.md, bench gates)
+# ---------------------------------------------------------------------------
+
+_RUNG_ORDER = ("one-shot", "frontier", "doubling", "sharded", "incremental",
+               "dispatch", "live")
+
+
+def collect_contracts(root: str, prefixes: Tuple[str, ...] = ("babble_tpu/tpu/",)
+                      ) -> List[Tuple[str, StagedFn, Contract]]:
+    """[(relpath, staged, contract)] across the staging scope, for the
+    generated contract table."""
+    out: List[Tuple[str, StagedFn, Contract]] = []
+    for prefix in prefixes:
+        base = os.path.join(root, prefix)
+        if not os.path.isdir(base):
+            continue
+        for fn in sorted(os.listdir(base)):
+            if not fn.endswith(".py"):
+                continue
+            rel = prefix + fn
+            try:
+                sf = SourceFile.parse(os.path.join(root, rel), rel)
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+            contracts = parse_contracts(sf)
+            for rec in find_staged(sf):
+                c = contracts.get(rec.name)
+                if c is not None:
+                    out.append((rel, rec, c))
+    return out
+
+
+def _fmt_absval(name: str, v: AbsVal) -> str:
+    dt = v.dtype or "any"
+    s = f"{name}:{dt}"
+    if v.rank is not None:
+        s += f"[{v.rank}]"
+    if v.layout:
+        s += f":{v.layout}"
+    return s
+
+
+def render_contract_table(root: str) -> str:
+    """Markdown table of every checked kernel contract, grouped by engine
+    rung — embedded between the contract-table markers in docs/tpu.md
+    (tests/test_staged.py asserts the embed is in sync)."""
+    rows = collect_contracts(root)
+
+    def key(item):
+        rel, rec, c = item
+        rung = c.rung or "?"
+        order = _RUNG_ORDER.index(rung) if rung in _RUNG_ORDER else 99
+        return (order, rel, rec.name)
+
+    lines = [
+        "| rung | staged function | kind | layouts | statics | donated "
+        "| mesh axes |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for rel, rec, c in sorted(rows, key=key):
+        lays = sorted({
+            v.layout for v in c.args.values() if v.layout
+        })
+        layouts = ", ".join(
+            "wide+packed" if l == "dual" else l for l in lays
+        ) or "—"
+        name = rec.public_name if rec.public_name != rec.name else rec.name
+        if rec.public_name and rec.public_name != rec.name:
+            name = f"{rec.public_name} ({rec.name})"
+        lines.append(
+            "| {rung} | `{name}` ({file}) | {kind} | {layouts} | {statics} "
+            "| {donated} | {mesh} |".format(
+                rung=c.rung or "—",
+                name=name,
+                file=rel.rsplit("/", 1)[-1],
+                kind=rec.kind,
+                layouts=layouts,
+                statics=", ".join(f"`{s}`" for s in c.statics) or "—",
+                donated=", ".join(f"`{d}`" for d in sorted(c.donate)) or "—",
+                mesh=", ".join(f"`{m}`" for m in c.mesh) or "—",
+            )
+        )
+    return "\n".join(lines)
+
+
+def kernel_baseline_entries(baseline_path: Optional[str] = None
+                            ) -> List[Dict[str, str]]:
+    """kernel-* entries in the checked-in lint baseline. The packed bench
+    headline and scripts/packed_smoke.py refuse to run when this is
+    non-empty: a contract violation must never ship behind a green bench
+    (ISSUE 18 bugfix)."""
+    from .core import load_baseline
+    from .runner import DEFAULT_BASELINE
+
+    entries = load_baseline(baseline_path or DEFAULT_BASELINE)
+    return [e for e in entries if e.get("rule", "").startswith("kernel-")]

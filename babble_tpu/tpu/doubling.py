@@ -160,6 +160,11 @@ def use_doubling(grid: DagGrid, prefer: bool = False) -> bool:
 # ---------------------------------------------------------------------------
 
 
+# kernel-contract: _closure_la
+#   in: creator:i32[1] index:i32[1] sp:i32[1] op:i32[1] rows_by:i32[2]
+#   static: l block pass_cap
+#   rung: doubling
+#   out: la:i32[2] passes:i32[0]
 @functools.partial(
     jax.jit, static_argnames=("l", "block", "pass_cap")
 )
@@ -277,6 +282,12 @@ def _m0_binsearch_from(fd_w, w_ok, rb, chain_len, la, lo0,
     return jnp.where(hi < chain_len, hi, sent)
 
 
+# kernel-contract: _walk_chunk
+#   in: inv_i32:i32[3] rows_by:i32[2] fd:i32[2] la:i32[2] x0:i32[1]
+#   in: seeds:i32[2] r_abs:i32[1] first_nw:i32[1]
+#   static: super_majority l length steps use_seeds
+#   rung: doubling
+#   out: x_last:i32[1] xs:i32[2]
 @functools.partial(
     jax.jit,
     static_argnames=("super_majority", "l", "length", "steps", "use_seeds"),
@@ -393,6 +404,12 @@ def _doubling_walk(put, inv_i32, rows_by_d, fd_d, la_d, x0, s_np, first_nw,
 # ---------------------------------------------------------------------------
 
 
+# kernel-contract: _fame_received
+#   in: wtable:i32[2] la:i32[2] fd:i32[2] index:i32[1] creator:i32[1]
+#   in: coin:bool[1]:wide rounds:i32[1] last_round:i32[0]
+#   static: super_majority n_participants d_cap packed
+#   rung: doubling
+#   out: decided:bool[2] famous:bool[2] rounds_decided:bool[1] received:i32[1]
 @functools.partial(
     jax.jit,
     static_argnames=("super_majority", "n_participants", "d_cap", "packed"),
@@ -416,6 +433,10 @@ def _fame_received(wtable, la, fd, index, creator, coin, rounds, last_round,
 # ---------------------------------------------------------------------------
 
 
+# kernel-contract: _lamport_levels_scan
+#   in: levels:i32[2] sp:i32[1] op:i32[1] esp:i32[1] eop:i32[1] fpin:i32[1]
+#   rung: doubling
+#   out: lamport:i32[1]
 @jax.jit
 def _lamport_levels_scan(levels, sp, op, esp, eop, fpin):
     """Device lamport recurrence over the level table: the scan step is

@@ -108,6 +108,10 @@ def level_lamport(grid: DagGrid) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+# kernel-contract: build_inv
+#   in: rows_by:i32[2] la:i32[2]
+#   rung: frontier
+#   out: inv:f32[3] (threshold tables, MXU-ready)
 @jax.jit
 def build_inv(rows_by: jax.Array, la: jax.Array) -> jax.Array:
     """INV[c, p, v] = first chain-c index whose p-coordinate >= v
@@ -286,6 +290,12 @@ def frontier_x0(rows_by) -> jax.Array:
     return jnp.where(rows_by[:, 0] >= 0, 0, jnp.int32(l)).astype(jnp.int32)
 
 
+# kernel-contract: _frontier_rounds
+#   in: inv_f32:f32[3] rows_by:i32[2] creator:i32[1] index:i32[1]
+#   in: sp_index:i32[1] fd:i32[2] la:i32[2]
+#   static: super_majority r_cap
+#   rung: frontier
+#   out: FrontierResult
 def _frontier_rounds(
     inv_f32, rows_by, creator, index, sp_index, fd, super_majority: int,
     r_cap: int, la=None,
@@ -338,6 +348,13 @@ frontier_rounds = functools.partial(
 )(_frontier_rounds)
 
 
+# kernel-contract: frontier_pipeline
+#   in: inv_f32:f32[3] rows_by:i32[2] creator:i32[1] index:i32[1]
+#   in: sp_index:i32[1] la:i32[2] fd:i32[2] lamport:i32[1]
+#   in: coin_bit:bool[1]:wide
+#   static: super_majority n_participants r_cap d_cap packed
+#   rung: frontier
+#   out: PipelineResult
 @functools.partial(
     jax.jit,
     static_argnames=(

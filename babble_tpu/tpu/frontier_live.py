@@ -176,6 +176,11 @@ def _append_train(state: FrState, train: Train) -> FrState:
     )
 
 
+# kernel-contract: _decide
+#   in: state:pytree
+#   static: super_majority n_participants packed
+#   rung: live
+#   out: FrState (undonated: the cold-start bootstrap re-reads its input)
 def _decide(state: FrState, super_majority: int, n_participants: int,
             packed: bool = False) -> FrState:
     """Warm-start windowed frontier walk + fame + received over the
@@ -285,6 +290,12 @@ def _decide(state: FrState, super_majority: int, n_participants: int,
     )
 
 
+# kernel-contract: frontier_train_step
+#   in: state:pytree train:pytree
+#   static: super_majority n_participants packed
+#   donate: state
+#   rung: live
+#   out: FrState after one append train + walk/fame/received
 @functools.partial(
     jax.jit,
     static_argnames=("super_majority", "n_participants", "packed"),
@@ -302,6 +313,12 @@ def frontier_train_step(
     )
 
 
+# kernel-contract: frontier_multi_train
+#   in: state:pytree stacked:pytree
+#   static: super_majority n_participants packed
+#   donate: state
+#   rung: live
+#   out: FrState after K scanned trains + one decide
 @functools.partial(
     jax.jit,
     static_argnames=("super_majority", "n_participants", "packed"),

@@ -464,6 +464,12 @@ def _decide_body(
     )
 
 
+# kernel-contract: _step_full
+#   in: state:pytree batch:pytree
+#   static: super_majority n_participants r_win e_win packed
+#   donate: state
+#   rung: incremental
+#   out: IncState (in-place via donation)
 def _step_full(state, batch, super_majority, n_participants,
                r_win: int = 32, e_win: int = 8192, packed: bool = False):
     return _decide_body(
@@ -483,6 +489,12 @@ step = functools.partial(
 )(_step_full)
 
 
+# kernel-contract: multi_step
+#   in: state:pytree stacked:pytree
+#   static: super_majority n_participants r_win e_win packed
+#   donate: state
+#   rung: incremental
+#   out: IncState after K scanned batches + one decide
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -751,6 +763,12 @@ def _train_body(state: IncState, train: Train, super_majority: int,
     )
 
 
+# kernel-contract: train_step
+#   in: state:pytree train:pytree
+#   static: super_majority n_participants r_win e_win packed
+#   donate: state
+#   rung: incremental
+#   out: IncState after one whole append train + one decide
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -777,6 +795,12 @@ def train_step(
     )
 
 
+# kernel-contract: multi_train
+#   in: state:pytree stacked:pytree
+#   static: super_majority n_participants r_win e_win packed
+#   donate: state
+#   rung: incremental
+#   out: IncState after K scanned trains + one decide
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -107,6 +107,14 @@ class PipelineResult(NamedTuple):
     last_round: jax.Array  # () int32
 
 
+# kernel-contract: _divide_rounds
+#   in: levels:i32[2] creator:i32[1] index:i32[1] self_parent:i32[1]
+#   in: other_parent:i32[1] la:i32[2] fd:i32[2] ext_sp_round:i32[1]
+#   in: ext_op_round:i32[1] fixed_round:i32[1] ext_sp_lamport:i32[1]
+#   in: ext_op_lamport:i32[1] fixed_lamport:i32[1]
+#   static: super_majority r_max packed
+#   rung: one-shot
+#   out: rounds:i32[1] witness:bool[1] lamport:i32[1] wtable:i32[2]
 def _divide_rounds(
     levels, creator, index, self_parent, other_parent, la, fd,
     ext_sp_round, ext_op_round, fixed_round, ext_sp_lamport, ext_op_lamport,
@@ -318,6 +326,12 @@ def _decide_fame_tables(
     return FameResult(decided, famous, rounds_decided)
 
 
+# kernel-contract: _decide_fame
+#   in: wtable:i32[2] la:i32[2] fd:i32[2] index:i32[1] coin_bit:bool[1]:wide
+#   in: last_round:i32[0]
+#   static: super_majority n_participants d_cap packed
+#   rung: one-shot
+#   out: FameResult (decided/famous bool[2] wide, rounds_decided bool[1])
 def _decide_fame(
     wtable, la, fd, index, coin_bit, last_round,
     super_majority: int, n_participants: int, d_cap: int,
@@ -401,6 +415,12 @@ def received_search(index, creator, rounds, min_la, famous_count, i_ok, horizon)
     )
 
 
+# kernel-contract: _decide_round_received
+#   in: wtable:i32[2] la:i32[2] index:i32[1] creator:i32[1] rounds:i32[1]
+#   in: decided:bool[2]:wide famous:bool[2]:wide rounds_decided:bool[1]
+#   in: last_round:i32[0]
+#   rung: one-shot
+#   out: received:i32[1] (-1 while undetermined)
 def _decide_round_received(
     wtable, la, index, creator, rounds, decided, famous, rounds_decided,
     last_round,
@@ -414,6 +434,14 @@ def _decide_round_received(
     )
 
 
+# kernel-contract: consensus_pipeline
+#   in: levels:i32[2] creator:i32[1] index:i32[1] self_parent:i32[1]
+#   in: other_parent:i32[1] la:i32[2] fd:i32[2] ext_sp_round:i32[1]
+#   in: ext_op_round:i32[1] fixed_round:i32[1] ext_sp_lamport:i32[1]
+#   in: ext_op_lamport:i32[1] fixed_lamport:i32[1] coin_bit:bool[1]:wide
+#   static: super_majority n_participants r_max r_fame d_cap packed
+#   rung: one-shot
+#   out: PipelineResult
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -737,6 +737,11 @@ def jnp_int32(x):
     return jnp.int32(x)
 
 
+# kernel-contract: _pack_results
+#   in: st:pytree lo:i32[0]
+#   static: e_win r_cap n
+#   rung: live
+#   out: one flat i32[1] vector (single host transfer)
 @functools.partial(jax.jit, static_argnames=("e_win", "r_cap", "n"))
 def _pack_results(st: IncState, lo, e_win: int, r_cap: int, n: int):
     """Flatten everything the host write-back reads into ONE int32 vector

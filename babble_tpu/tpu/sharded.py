@@ -137,6 +137,14 @@ def _fame_loop_fn(mesh: Mesh, axis: str, chunk: int, n_participants: int,
     # every validator shard ring-shifts its own witness slice)
     perm = [(i, (i - 1) % ndev_r) for i in range(ndev_r)]
 
+    # kernel-contract: local_fame
+    #   in: last_round:i32[0] i_rows:i32[1] wvalid:bool[2]:wide
+    #   in: votes:any[3]:dual decided:bool[2]:wide famous:bool[2]:wide
+    #   in: ss_s:any[3]:dual wv_s:bool[2]:wide coin_s:bool[2]:wide
+    #   donate: votes decided famous ss_s wv_s coin_s
+    #   mesh: axis v_axis
+    #   rung: sharded
+    #   out: votes:any[3]:dual decided:bool[2]:wide famous:bool[2]:wide
     def local_fame(last_round, i_rows, wvalid, votes, decided, famous,
                    ss_s, wv_s, coin_s):
         def shift1(x):
@@ -283,6 +291,13 @@ def _received_fn(mesh: Mesh, axis):
     all seven are donated (ISSUE 9: the received stage stops
     double-buffering, same as the fame loop's carried set)."""
 
+    # kernel-contract: local_received
+    #   in: index:i32[1] creator:i32[1] rounds:i32[1] min_la:i32[2]
+    #   in: famous_count:i32[1] i_ok:bool[1] horizon:i32[1]
+    #   donate: index creator rounds min_la famous_count i_ok horizon
+    #   mesh: axis
+    #   rung: sharded
+    #   out: received:i32[1]
     def local_received(index, creator, rounds, min_la, famous_count, i_ok,
                        horizon):
         # the exact single-device candidate search, applied to the local
@@ -304,6 +319,11 @@ def _received_fn(mesh: Mesh, axis):
     )
 
 
+# kernel-contract: _fame_tables
+#   in: wtable:i32[2] la:i32[2] decided:bool[2]:wide famous:bool[2]:wide
+#   in: last_round:i32[0]
+#   rung: sharded
+#   out: min_la/famous_count/i_ok/horizon/rounds_decided
 @jax.jit
 def _fame_tables(wtable, la, decided, famous, last_round):
     """Replicated post-fame tables consumed by the received map (shared
@@ -516,6 +536,12 @@ def _frontier_walk_fn(mesh: Mesh, axis, super_majority: int, r_cap: int,
     N=1024 even sharded)."""
     from .frontier import M0_BINSEARCH_MIN_N, _m0_binsearch
 
+    # kernel-contract: local_walk
+    #   in: inv_local:f32[3] rb_local:i32[2] fd:i32[2] la:i32[2]
+    #   in: x0_local:i32[1]
+    #   mesh: axis
+    #   rung: sharded
+    #   out: x_hist_local:i32[2] (undonated: the r_cap retry re-reads inputs)
     def local_walk(inv_local, rb_local, fd, la, x0_local):
         # (B, N_p, L), (B, L), (E, N_p) replicated, (E, N_p) replicated, (B,)
         b = rb_local.shape[0]

@@ -408,6 +408,25 @@ def main(argv=None):
                          "series as BENCH_PACKED_r*.json)")
     args = ap.parse_args(argv)
 
+    if args.headline == "packed":
+        # a packed headline over kernels whose contract violations were
+        # baselined instead of fixed is a green number on unproven code
+        # (ISSUE 18): refuse until the baseline carries no kernel-* entry
+        from babble_tpu.analysis.staged import kernel_baseline_entries
+
+        stale = kernel_baseline_entries()
+        if stale:
+            rules = ", ".join(sorted({e.get("rule", "?") for e in stale}))
+            print(
+                f"bench_mesh_scale: REFUSING --headline packed — the lint "
+                f"baseline carries {len(stale)} kernel-* finding(s) "
+                f"({rules}). Fix them (`babble-tpu lint --staged`) rather "
+                f"than baselining; the packed headline must only be "
+                f"measured over contract-proven kernels.",
+                file=sys.stderr,
+            )
+            return 2
+
     import jax
 
     sweep = [int(x) for x in args.validators.split(",") if x.strip()]

@@ -5,9 +5,11 @@
 #
 # Tier 1 (hard, stdlib-only): the consensus-grade analyzers in
 #   babble_tpu/analysis/ — determinism lint, lock-discipline checker,
-#   JAX staging audit, observability lint (obs-* rules: metric names
-#   must be static literals, label sets declared literally). New
-#   findings (not in the checked-in baseline) fail the build.
+#   JAX staging audit, staged-kernel contract checker (--staged:
+#   kernel-* rules over tpu/), observability lint (obs-* rules: metric
+#   names must be static literals, label sets declared literally). New
+#   findings (not in the checked-in baseline) fail the build, and the
+#   gate must finish inside a 30s wall-time budget.
 # Tier 2 (advisory): ruff/mypy per the pyproject.toml baseline config,
 #   run only where installed (pip install -e '.[lint]'); absence is a
 #   skip, not a failure, because the node image ships without them.
@@ -17,7 +19,13 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== babble-tpu lint (hard gate) =="
-python -m babble_tpu lint || rc=1
+lint_start=$(date +%s)
+python -m babble_tpu lint --staged || rc=1
+lint_elapsed=$(( $(date +%s) - lint_start ))
+if [ "$lint_elapsed" -ge 30 ]; then
+    echo "ci_lint: FAIL — lint gate took ${lint_elapsed}s, over the 30s wall-time budget"
+    rc=1
+fi
 
 # Dynamic concurrency certification (hard gate, ISSUE 12): a seeded sim
 # sweep under lockset/lock-order instrumentation. Seeds are env-tunable:

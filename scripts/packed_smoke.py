@@ -22,6 +22,24 @@ FIXTURES = (
 def main() -> int:
     import numpy as np
 
+    # a byte-equality smoke over kernels whose contract violations were
+    # baselined instead of fixed proves nothing (ISSUE 18): refuse until
+    # the baseline carries no kernel-* entry
+    from babble_tpu.analysis.staged import kernel_baseline_entries
+
+    stale = kernel_baseline_entries()
+    if stale:
+        rules = ", ".join(sorted({e.get("rule", "?") for e in stale}))
+        print(
+            f"packed_smoke: REFUSING to run — the lint baseline carries "
+            f"{len(stale)} kernel-* finding(s) ({rules}). Fix them "
+            f"(`babble-tpu lint --staged`) rather than baselining; the "
+            f"packed/wide equality gate must only run over "
+            f"contract-proven kernels.",
+            file=sys.stderr,
+        )
+        return 2
+
     from babble_tpu.obs import bisect_pass_results
     from babble_tpu.tpu.engine import run_frontier_passes, run_passes
     from babble_tpu.tpu.grid import synthetic_grid
