@@ -44,6 +44,14 @@ Rules (waiver tag `obs-ok`):
   provenance stream's determinism fingerprint, which joins the sim's
   byte-identical-replay contract (docs/sim.md) — the same reasoning as
   flight-recorder record names.
+- obs-ledger-static-name — a device-time ledger emission whose entry,
+  rung or component name is not a string literal: `ledger_call(entry,
+  fn, ...)` anywhere, and `*.call/activate/component(...)` on a
+  devledger/ledger/led receiver.  Ledger cell names feed the per-pass
+  metric labels (babble_kernel_pass_seconds), the ledger fingerprint in
+  the sim determinism contract, and the trend-attribution map in
+  scripts/bench_trend.py — a computed name breaks all three
+  (docs/observability.md).
 
 Scope: any call `<recv>.counter|gauge|histogram(...)` where the receiver
 chain ends in `obs`, `registry`, `reg` or `metrics` — the conventional
@@ -78,6 +86,15 @@ SLO_RECEIVER_TAILS = {"slo"}
 
 PROV_METHODS = {"mark"}
 PROV_RECEIVER_TAILS = {"provenance", "prov"}
+
+LEDGER_METHODS = {"call", "activate", "component"}
+LEDGER_RECEIVER_TAILS = {"devledger", "ledger", "led", "_led", "_ledger"}
+# positional index of each name argument that must be a string literal
+LEDGER_NAME_ARGS = {
+    "call": (("entry", 0),),
+    "activate": (("rung", 0),),
+    "component": (("rung", 0), ("component", 1)),
+}
 
 # Vocabulary that must never appear in hashgraph/event.py (signed-body
 # construction): identifiers or short key-like strings naming the causal
@@ -142,6 +159,16 @@ def _prov_receiver(func: ast.Attribute) -> Optional[str]:
     return recv if tail in PROV_RECEIVER_TAILS else None
 
 
+def _ledger_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a ledger emission, or None when this is
+    not a ledger call we police (e.g. `queue.call(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in LEDGER_RECEIVER_TAILS else None
+
+
 def _slo_receiver(func: ast.Attribute) -> Optional[str]:
     """The receiver chain of an SLO declaration, or None when this is
     not an engine call we police."""
@@ -189,7 +216,34 @@ class _ObsVisitor(SymbolTracker):
             recv = _prov_receiver(func)
             if recv is not None:
                 self._check_prov(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in LEDGER_METHODS:
+            recv = _ledger_receiver(func)
+            if recv is not None:
+                self._check_ledger(node, recv, func.attr)
+        if (isinstance(func, ast.Name) and func.id == "ledger_call") or (
+            isinstance(func, ast.Attribute) and func.attr == "ledger_call"
+        ):
+            self._check_ledger(node, "ledger_call", "call")
         self.generic_visit(node)
+
+    def _check_ledger(self, node: ast.Call, recv: str, method: str) -> None:
+        for name, idx in LEDGER_NAME_ARGS[method]:
+            arg: Optional[ast.AST] = (
+                node.args[idx] if len(node.args) > idx else None
+            )
+            for kw in node.keywords:
+                if kw.arg == name:
+                    arg = kw.value
+            if arg is None or not _is_str_literal(arg):
+                self._emit(
+                    "obs-ledger-static-name", node,
+                    f"{recv}(...) records into the device-time ledger with "
+                    f"a computed {name}; ledger entry/rung/component names "
+                    "must be static string literals — they label "
+                    "babble_kernel_pass_seconds, join the sim ledger "
+                    "fingerprint, and key the trend-attribution map "
+                    "(docs/observability.md)",
+                )
 
     def _check_prov(self, node: ast.Call, recv: str, method: str) -> None:
         name_arg: Optional[ast.AST] = node.args[0] if node.args else None

@@ -1198,7 +1198,32 @@ class Node(NodeStateMachine):
             **self._live_engine_stats(),
             **self._mesh_stats(),
             **self._table_bytes_stats(),
+            **self._ledger_stats(),
         }
+
+    def _ledger_stats(self):
+        """Device-time ledger (ISSUE 19): per-pass ms totals plus the
+        compile/retrace counters, flattened into the flat-string /stats
+        surface like the sibling adapters. Keys appear only once a
+        device pass has actually been ledgered; the retrace count is the
+        headline health figure (steady state must read 0)."""
+        led = self.obs.devledger
+        snap = led.snapshot()
+        if not snap["cells"]:
+            return {}
+        out = {}
+        per_pass: Dict[str, float] = {}
+        for key, (_calls, secs) in snap["cells"].items():
+            rung, pass_name, _layout, _comp = key.split("/")
+            k = f"{rung}/{pass_name}"
+            per_pass[k] = per_pass.get(k, 0.0) + secs
+        for k in sorted(per_pass):
+            out[f"ledger_ms_{k.replace('/', '_')}"] = f"{per_pass[k] * 1e3:.2f}"
+        compiles = sum(e["compiles"] for e in snap["entries"].values())
+        retraces = sum(e["retraces"] for e in snap["entries"].values())
+        out["kernel_compiles"] = str(int(compiles))
+        out["kernel_retraces"] = str(int(retraces))
+        return out
 
     def _table_bytes_stats(self):
         """Voting-table footprint of the layout the device engine last ran

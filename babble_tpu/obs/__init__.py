@@ -21,6 +21,14 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from ..common.clock import Clock, SYSTEM_CLOCK
+from .devledger import (
+    DeviceLedger,
+    ENTRY_INFO,
+    build_timeline,
+    ledger_call,
+    retrace_baseline,
+    retrace_delta,
+)
 from .flightrec import (
     DEFAULT_FLIGHT_CAPACITY,
     FlightRecord,
@@ -59,6 +67,12 @@ from .tracectx import (
 
 __all__ = [
     "Observability",
+    "DeviceLedger",
+    "ENTRY_INFO",
+    "build_timeline",
+    "ledger_call",
+    "retrace_baseline",
+    "retrace_delta",
     "FlightRecorder",
     "FlightRecord",
     "SLOEngine",
@@ -124,6 +138,11 @@ class Observability:
             clock=self.clock, node_id=node_id, registry=self.registry,
             tracer=self.tracer, capacity=trace_capacity, enabled=tracing,
         )
+        # device-time ledger (ISSUE 19): per-pass kernel cost cells,
+        # compile/retrace accounting over jax.monitoring, and the seam
+        # ring behind GET /debug/timeline — durations follow the clock
+        # policy (real SystemClock only; the sim records exact zeros)
+        self.devledger = DeviceLedger(self)
 
     # Delegates so call sites read `obs.counter("...")`. The name flows
     # through a parameter here, which the obs-dynamic-name rule cannot

@@ -282,6 +282,18 @@ class Service:
                                     trace_id=tid,
                                 )
                             ).encode()
+                        elif self.path.startswith("/debug/timeline"):
+                            obs = getattr(service.node, "obs", None)
+                            if obs is None:
+                                self.send_error(404, "node has no obs")
+                                return
+                            from .obs.devledger import build_timeline
+
+                            q = parse_qs(urlparse(self.path).query)
+                            tid = q.get("trace_id", [None])[0]
+                            body = json.dumps(
+                                build_timeline(obs, trace_id=tid)
+                            ).encode()
                         elif self.path == "/debug/flightrec":
                             obs = getattr(service.node, "obs", None)
                             flightrec = getattr(obs, "flightrec", None)

@@ -663,6 +663,7 @@ class SimCluster:
             "trace_fingerprint": self.trace_fingerprint(),
             "flightrec_fingerprint": self.flightrec_fingerprint(),
             "provenance_fingerprint": self.provenance_fingerprint(),
+            "ledger_fingerprint": self.ledger_fingerprint(),
             "flightrec_records": {
                 sn.name: len(sn.node.obs.flightrec)
                 for sn in self.sns
@@ -785,6 +786,21 @@ class SimCluster:
                 continue
             h.update(sn.name.encode())
             h.update(sn.node.obs.flightrec.stream_bytes())
+        return h.hexdigest()
+
+    def ledger_fingerprint(self) -> str:
+        """SHA-256 over every live node's canonical device-ledger
+        snapshot, in node order — the device-time ledger's entry in the
+        determinism fingerprint (ISSUE 19): under the sim clock every
+        duration records as 0.0, so two runs of the same seed+plan must
+        produce byte-identical ledgers (same cells, same call counts,
+        same compile/retrace tallies)."""
+        h = sha256()
+        for sn in self.sns:
+            if sn.crashed:
+                continue
+            h.update(sn.name.encode())
+            h.update(sn.node.obs.devledger.fingerprint().encode())
         return h.hexdigest()
 
     def provenance_fingerprint(self) -> str:

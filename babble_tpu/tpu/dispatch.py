@@ -62,18 +62,24 @@ class _AsyncPass:
     blocks in result()."""
 
     def __init__(self, mesh, grid, prefer_doubling: bool = False,
-                 packed=None):
+                 packed=None, ledger=None):
         self.done = threading.Event()
         # unguarded-ok: Event handoff — _run's writes happen-before
         # done.set(), and result() reads only after done.wait()
         self.value = None
         # unguarded-ok: same Event handoff as value
         self.error: Optional[BaseException] = None
+        # device-time ledger (ISSUE 19): the worker re-activates it on
+        # its own thread (thread-locals don't cross the spawn). Safe off
+        # the serve thread by the ledger's clock policy: it reads only a
+        # real SystemClock and records 0.0 under any virtual clock.
+        self._ledger = ledger
         # layout resolved at DISPATCH time (tpu/packed.py), so a knob
         # flip cannot split one queued pipeline across layouts
         from .packed import resolve_packed
 
         packed = resolve_packed(packed, grid.n)
+        self.layout = "packed" if packed else "wide"
         threading.Thread(
             target=self._run, args=(mesh, grid, prefer_doubling, packed),
             name="mesh-dispatch", daemon=True,
@@ -81,6 +87,8 @@ class _AsyncPass:
 
     def _run(self, mesh, grid, prefer_doubling: bool, packed: bool) -> None:
         try:
+            import contextlib
+
             from .doubling import use_doubling
             from .engine import _frontier_safe
             from .grid import GridUnsupported
@@ -90,7 +98,18 @@ class _AsyncPass:
                 sharded_run_passes,
             )
 
-            with _MESH_EXEC_LOCK:
+            seam = (
+                self._ledger.activate(
+                    "mesh_queued", layout="packed" if packed else "wide",
+                    measure_sync=True,
+                )
+                if self._ledger is not None
+                else contextlib.nullcontext()
+            )
+            # seam outside the exec lock: time spent queued behind another
+            # worker's dispatch is part of what the integrator sees as
+            # blocked wall, so it belongs in the sync residual
+            with seam, _MESH_EXEC_LOCK:
                 # a batched dispatch (prefer_doubling) lowers the cold-
                 # path crossover: one doubling train amortizes the whole
                 # multi-round batch in O(log depth) passes (ISSUE 9)
@@ -314,9 +333,12 @@ class MeshDispatchQueue:
 
         pk = resolve_packed(None, grid.n)
         observe_table_bytes(hg.obs, grid.n, grid.r_max, pk)
+        layout = "packed" if pk else "wide"
+        hg.obs.devledger.component("mesh_queued", "stage", dt, layout=layout)
         self.inflight.append(
             (
-                _AsyncPass(self.mesh, grid, prefer_doubling=batched, packed=pk),
+                _AsyncPass(self.mesh, grid, prefer_doubling=batched, packed=pk,
+                           ledger=hg.obs.devledger),
                 grid, topo_hi, clock.monotonic(),
             )
         )
@@ -348,8 +370,16 @@ class MeshDispatchQueue:
         hg.obs.tracer.record(
             "device.fetch", t0, dt, {"node": hg.obs.node_id},
         )
+        led = hg.obs.devledger
+        layout = getattr(task, "layout", "wide")
+        led.component("mesh_queued", "fetch", dt, layout=layout)
+        _ti0 = clock.monotonic()
         integrate_pass_results(hg, grid, res, topo_hi=topo_hi,
                                engine="mesh-queued")
+        led.component(
+            "mesh_queued", "integrate", clock.monotonic() - _ti0,
+            layout=layout,
+        )
         self.integrations += 1
         # rounds newly covered by this dispatch: a DAG fact (last_round
         # delta), so the histogram is byte-identical across same-seed

@@ -72,6 +72,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.devledger import ledger_call
 from .engine import PassResults, _bucket, _frontier_safe, pad_grid, rebase_rounds
 from .frontier import build_inv, level_lamport
 from .grid import DagGrid, GridUnsupported, MAX_INT32, MIN_INT32
@@ -377,7 +378,8 @@ def _doubling_walk(put, inv_i32, rows_by_d, fd_d, la_d, x0, s_np, first_nw,
         rem = max(l - int(x_cur.min()), 1)
         steps = min(-(-(rem.bit_length() + 1) // 4) * 4, full_steps)
         r_vec = (r_done + np.arange(chunk)).astype(np.int32)
-        x_last_d, xs_d = _walk_chunk(
+        x_last_d, xs_d = ledger_call(
+            "_walk_chunk", _walk_chunk,
             inv_i32, rows_by_d, fd_d, la_d, put(x_cur), put(seg), put(r_vec),
             first_nw_d, super_majority, l, chunk, steps, use_seeds,
         )
@@ -475,7 +477,8 @@ def seeded_lamport(grid: DagGrid) -> np.ndarray:
     levels[: grid.num_levels] = grid.levels[: grid.num_levels]
     e_b = _bucket(grid.e, 256)
     pad_e = e_b - grid.e
-    lam = _lamport_levels_scan(
+    lam = ledger_call(
+        "_lamport_levels_scan", _lamport_levels_scan,
         jnp.asarray(levels),
         jnp.asarray(_pad1(grid.self_parent, pad_e, -1)),
         jnp.asarray(_pad1(grid.other_parent, pad_e, -1)),
@@ -620,7 +623,8 @@ def _doubling_stage1(grid: DagGrid, put, stats: dict):
     block = min(e_b, max(256, min(2048, (1 << 24) // max(n * n, 1))))
     block = 1 << (block.bit_length() - 1)
     pass_cap = max(l_b.bit_length(), 1) + 4
-    la_closed_d, passes_d = _closure_la(
+    la_closed_d, passes_d = ledger_call(
+        "_closure_la", _closure_la,
         creator_d, idx_d, put(sp_p), put(op_p), rows_by_d,
         l_b, block, pass_cap,
     )
@@ -727,7 +731,8 @@ def run_doubling_passes(
     grid_p = pad_grid(grid_rb)
     rounds_p = _pad1(rounds_np, grid_p.creator.shape[0] - e_real, -1)
     d_cap = d_max if d_max is not None else wtable_np.shape[0] + 2
-    decided_d, famous_d, rdec_d, received_d = _fame_received(
+    decided_d, famous_d, rdec_d, received_d = ledger_call(
+        "_fame_received", _fame_received,
         jax.device_put(wtable_np), jax.device_put(grid_p.last_ancestors),
         jax.device_put(grid_p.first_descendants),
         jax.device_put(grid_p.index), jax.device_put(grid_p.creator),

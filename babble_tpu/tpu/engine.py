@@ -17,6 +17,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.devledger import ledger_call
 from .grid import MAX_INT32, MIN_INT32, DagGrid, GridUnsupported, grid_from_hashgraph
 from . import kernels
 from .packed import observe_table_bytes, resolve_packed
@@ -171,7 +172,8 @@ def run_passes(
         # the fame offset loop is self-bounding (j <= last_round); d_cap is
         # a static safety net only, so it never triggers recompiles
         d_cap = d_max if d_max is not None else r_fame + 2
-        return kernels.consensus_pipeline(
+        return ledger_call(
+            "consensus_pipeline", kernels.consensus_pipeline,
             grid.levels,
             grid.creator,
             grid.index,
@@ -298,10 +300,11 @@ def run_frontier_passes(
         ext[:, : rows_by.shape[1]] = rows_by
         rows_by = ext
 
-    inv = build_inv(rows_by, grid_p.last_ancestors)
+    inv = ledger_call("build_inv", build_inv, rows_by, grid_p.last_ancestors)
 
     def run_fn(r_cap):
-        return frontier_pipeline(
+        return ledger_call(
+            "frontier_pipeline", frontier_pipeline,
             inv, rows_by, grid_p.creator, index, sp_index,
             grid_p.last_ancestors, grid_p.first_descendants,
             lamport, grid_p.coin_bit,
@@ -482,6 +485,8 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         "Device wall time per device consensus call",
         labels=("path",),
     )
+    _led = obs.devledger
+    _layout = "packed" if pk else "wide"
     if mesh is not None:
         from .doubling import observe_catchup, use_doubling
         from .dispatch import _MESH_EXEC_LOCK
@@ -497,9 +502,10 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
         # deadlock the mesh (tpu/dispatch.py _MESH_EXEC_LOCK)
         from .sharded import sharded_engine_tag
 
+        _led.component("sharded", "stage", _stage_s, layout=_layout)
         _t1 = clock.monotonic()
         _dbl_stats = None
-        with _MESH_EXEC_LOCK:
+        with _MESH_EXEC_LOCK, _led.activate("sharded", layout=_layout):
             res = None
             if use_doubling(grid):
                 # deep section: the log-diameter cold path, sharded
@@ -538,29 +544,46 @@ def run_consensus_device(hg, d_max: Optional[int] = None, mesh=None) -> None:
             _t1 = clock.monotonic()
             _dbl_stats = {}
             try:
-                res = run_doubling_passes(
-                    grid, d_max=d_max, stats=_dbl_stats, packed=pk
-                )
+                with _led.activate("doubling", layout=_layout):
+                    res = run_doubling_passes(
+                        grid, d_max=d_max, stats=_dbl_stats, packed=pk
+                    )
             except GridUnsupported:
                 res = None
             if res is not None:
                 _run_s = clock.monotonic() - _t1
                 _m_run.labels(path="oneshot").observe(_run_s)
                 observe_catchup(obs, _dbl_stats, _run_s)
+                _led.component("doubling", "stage", _stage_s, layout=_layout)
                 _engine = "doubling"
         if res is None and _frontier_safe(grid):
             _t1 = clock.monotonic()
-            res = run_frontier_passes(grid, d_max=d_max, packed=pk)
+            with _led.activate("frontier", layout=_layout):
+                res = run_frontier_passes(grid, d_max=d_max, packed=pk)
             _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
+            _led.component("frontier", "stage", _stage_s, layout=_layout)
         elif res is None:
             _t1 = clock.monotonic()
-            res = run_passes(
-                grid, d_max=d_max, bucketed=True, adaptive_r=True, packed=pk
-            )
+            with _led.activate("oneshot", layout=_layout):
+                res = run_passes(
+                    grid, d_max=d_max, bucketed=True, adaptive_r=True,
+                    packed=pk,
+                )
             _m_run.labels(path="oneshot").observe(clock.monotonic() - _t1)
+            _led.component("oneshot", "stage", _stage_s, layout=_layout)
 
     observe_table_bytes(obs, grid.n, res.witness_table.shape[0], pk)
+    _ti0 = _led.now()
     integrate_pass_results(hg, grid, res, engine=_engine)
+    _ti = _led.now() - _ti0
+    if mesh is not None:
+        _led.component("sharded", "integrate", _ti, layout=_layout)
+    elif _engine == "doubling":
+        _led.component("doubling", "integrate", _ti, layout=_layout)
+    elif _engine == "oneshot" and _frontier_safe(grid):
+        _led.component("frontier", "integrate", _ti, layout=_layout)
+    else:
+        _led.component("oneshot", "integrate", _ti, layout=_layout)
 
 
 def integrate_pass_results(hg, grid, res, topo_hi: Optional[int] = None,

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.devledger import ledger_call
 from .grid import MAX_INT32, DagGrid, GridUnsupported, grid_from_hashgraph
 from .incremental import (
     Batch,
@@ -570,25 +571,35 @@ class LiveDeviceEngine:
             built.append(batch)
             new_rows.extend(rows)
 
-        if len(built) <= 2:
-            for b in built:
-                self.state = step(
-                    self.state, b, self.hg.super_majority, self.n,
-                    e_win=self.e_win, r_win=self.r_win, packed=self.packed,
-                )
-                self.dispatches += 1
-        else:
-            for i in range(0, len(built), 16):
-                group = built[i : i + 16]
-                k = 4 if len(group) <= 4 else 16
-                group = group + [self._empty_batch()] * (k - len(group))
-                self.state = multi_step(
-                    self.state, stack_batches(group),
-                    self.hg.super_majority, self.n, e_win=self.e_win,
-                    r_win=self.r_win, packed=self.packed,
-                )
-                self.dispatches += 1
+        led = self.hg.obs.devledger
+        layout = "packed" if self.packed else "wide"
+        # batch building is the live rung's host staging work; the step/
+        # multi_step launches below are attributed by their own seams
+        led.component("live", "stage", clock.monotonic() - t0, layout=layout)
+        with led.activate("live", layout=layout):
+            if len(built) <= 2:
+                for b in built:
+                    self.state = ledger_call(
+                        "_step_full", step,
+                        self.state, b, self.hg.super_majority, self.n,
+                        e_win=self.e_win, r_win=self.r_win,
+                        packed=self.packed,
+                    )
+                    self.dispatches += 1
+            else:
+                for i in range(0, len(built), 16):
+                    group = built[i : i + 16]
+                    k = 4 if len(group) <= 4 else 16
+                    group = group + [self._empty_batch()] * (k - len(group))
+                    self.state = ledger_call(
+                        "multi_step", multi_step,
+                        self.state, stack_batches(group),
+                        self.hg.super_majority, self.n, e_win=self.e_win,
+                        r_win=self.r_win, packed=self.packed,
+                    )
+                    self.dispatches += 1
         dt = clock.monotonic() - t0
+        led.component("live", "stage", dt, layout=layout)
         self._m_dispatch.observe(dt)
         self.hg.obs.tracer.record(
             "device.dispatch", t0, dt,
@@ -895,9 +906,13 @@ def _dispatch(eng: LiveDeviceEngine, new_rows: List[int]):
     """Launch the packed-results program for the current device state.
     Returns (device_array, snapshot); does NOT block on the transfer."""
     snap = _snapshot(eng, new_rows)
-    packed = _pack_results(
-        eng.state, jnp_int32(snap["lo"]), eng.e_win, eng.r_cap, eng.n
-    )
+    with eng.hg.obs.devledger.activate(
+        "live", layout="packed" if eng.packed else "wide",
+    ):
+        packed = ledger_call(
+            "_pack_results", _pack_results,
+            eng.state, jnp_int32(snap["lo"]), eng.e_win, eng.r_cap, eng.n,
+        )
     return packed, snap
 
 
@@ -910,6 +925,9 @@ def _run_sync(hg, eng: LiveDeviceEngine, new_rows: List[int]) -> None:
     packed = jax.device_get(packed_dev)
     dt = clock.monotonic() - t0
     eng._m_fetch.observe(dt)
+    hg.obs.devledger.component(
+        "live", "fetch", dt, layout="packed" if eng.packed else "wide",
+    )
     hg.obs.tracer.record(
         "device.fetch", t0, dt, {"node": hg.obs.node_id},
     )
@@ -944,6 +962,9 @@ def _integrate_oldest(hg, eng: LiveDeviceEngine) -> int:
     packed = fetch.result()  # normally already resident
     dt = clock.monotonic() - t0
     eng._m_fetch.observe(dt)
+    hg.obs.devledger.component(
+        "live", "fetch", dt, layout="packed" if eng.packed else "wide",
+    )
     in_flight = max(t0 + dt - t_disp, 1e-9)
     eng._m_overlap.observe(max(0.0, min(1.0, 1.0 - dt / in_flight)))
     hg.obs.tracer.record(
@@ -1041,6 +1062,8 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
     from ..common import StoreErr, StoreErrType, is_store_err
     from ..hashgraph import PendingRound, RoundInfo
 
+    _led = hg.obs.devledger
+    _ti0 = _led.now()
     count, lo, base = snap["count"], snap["lo"], snap["base"]
     if base != eng.round_base:
         # rebases are ordered strictly between integrations; a mismatch
@@ -1242,6 +1265,10 @@ def _integrate(hg, eng: LiveDeviceEngine, packed, snap: dict) -> int:
 
     if prov_cells:
         prov.mark("prov.capture", engine="live", cells=prov_cells)
+    _led.component(
+        "live", "integrate", _led.now() - _ti0,
+        layout="packed" if eng.packed else "wide",
+    )
     return last_round_rel
 
 

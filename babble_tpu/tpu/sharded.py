@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.devledger import ledger_call
 from . import kernels
 from .engine import PassResults
 from .frontier import frontier_post
@@ -418,18 +419,20 @@ def _sharded_fame_received(
         mesh, axis, chunk, grid.n, grid.super_majority, r_pad + 2, v_axis,
         packed=pk,
     )
-    votes, decided, famous = fame_loop(
+    votes, decided, famous = ledger_call(
+        "local_fame", fame_loop,
         last_round, i_rows, wvalid_s, votes, decided, famous,
         ss_s, wv_s, coin_s,
     )
 
-    min_la, famous_count, i_ok, horizon, rounds_decided = _fame_tables(
-        wtable, la, decided, famous, last_round
+    min_la, famous_count, i_ok, horizon, rounds_decided = ledger_call(
+        "_fame_tables", _fame_tables, wtable, la, decided, famous, last_round
     )
     pute = lambda x, fill: jax.device_put(
         _pad_axis0(np.asarray(x), e_pad, fill), NamedSharding(mesh, P(ev_axes))
     )
-    received = _received_fn(mesh, ev_axes)(
+    received = ledger_call(
+        "local_received", _received_fn(mesh, ev_axes),
         pute(grid.index, 0), pute(grid.creator, 0),
         pute(rounds_np, -1),
         jax.device_put(min_la, rep), jax.device_put(famous_count, rep),
@@ -460,7 +463,8 @@ def sharded_run_passes(
     la = putr(grid.last_ancestors)
     fd = putr(grid.first_descendants)
     index = putr(grid.index)
-    dr = kernels.divide_rounds(
+    dr = ledger_call(
+        "_divide_rounds", kernels.divide_rounds,
         putr(grid.levels), putr(grid.creator), index,
         putr(grid.self_parent), putr(grid.other_parent), la, fd,
         putr(grid.ext_sp_round), putr(grid.ext_op_round),
@@ -668,7 +672,8 @@ def sharded_frontier_passes(
     rb_dev = jax.device_put(rb_pad, shard_c)
 
     # ---- pass 1a: INV construction, chains-sharded ----
-    inv = _sharded_build_inv_fn(mesh, axis)(rb_dev, la)
+    inv = ledger_call("build_inv", _sharded_build_inv_fn(mesh, axis),
+                      rb_dev, la)
 
     # ---- pass 1b: frontier walk, chains-sharded ----
     x0 = jax.device_put(
@@ -676,8 +681,10 @@ def sharded_frontier_passes(
         NamedSharding(mesh, P(axis)),
     )
     while True:
-        x_hist = _frontier_walk_fn(mesh, axis, grid.super_majority, r_cap, l_b)(
-            inv, rb_dev, fd, la, x0
+        x_hist = ledger_call(
+            "local_walk",
+            _frontier_walk_fn(mesh, axis, grid.super_majority, r_cap, l_b),
+            inv, rb_dev, fd, la, x0,
         )
 
         # ---- pass 1c: witness table + per-event rounds (shared post-walk) --

@@ -236,6 +236,20 @@ def main(argv=None):
     from babble_tpu.obs import Observability, log_buckets
 
     obs = Observability()
+    # device-time ledger (ISSUE 19): one ledgered pass of the exact
+    # batch the timed loop ran — outside the measurement so the seam
+    # cost cannot perturb the headline; the executable is warm, so this
+    # records a pure run cell plus the entry's byte traffic
+    from babble_tpu.obs import ledger_call
+
+    with obs.devledger.activate("frontier"):
+        ledger_call(
+            "frontier_pipeline", frontier_pipeline,
+            inv, dev["rows_by"], dev["creator"], dev["index"],
+            dev["sp_index"], dev["last_ancestors"],
+            dev["first_descendants"], dev["lamport"], dev["coin_bit"],
+            grid.super_majority, grid.n, r_fame,
+        )
     bench_hist = obs.histogram(
         "babble_bench_iteration_seconds",
         "Per-iteration wall time of the benchmark device pipeline",
@@ -259,6 +273,10 @@ def main(argv=None):
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / TARGET_EVENTS_PER_SEC, 3),
+                "ledger": {
+                    "shares": obs.devledger.snapshot()["shares"],
+                    "efficiency": obs.devledger.efficiency(),
+                },
                 "metrics": obs.registry.snapshot(),
             }
         )

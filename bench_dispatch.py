@@ -70,6 +70,16 @@ def slo_gate(obs, max_blocked_s: float):
         description="queued-mesh blocked device time per sync stays "
                     "under the ceiling",
     )
+    # steady-state retrace budget (ISSUE 19): zero kernel retraces past
+    # the warmup baseline — a nonzero delta means some staged callable
+    # is being rebuilt per call and the compile cache never serves it
+    slo.objective(
+        "retrace_budget",
+        series="babble_bench_retrace_delta",
+        kind="below", threshold=1.0,
+        description="steady-state kernel retraces past warmup stay at "
+                    "zero",
+    )
     status = slo.evaluate()
     return not slo.breached(), status
 
@@ -108,10 +118,24 @@ def main(argv=None):
         time.sleep(GOSSIP_INTERVAL_S)
         return build_levels(N_VALIDATORS, grid.self_parent, grid.other_parent)
 
+    from babble_tpu.obs import (
+        Observability,
+        log_buckets,
+        retrace_baseline,
+        retrace_delta,
+    )
+
+    obs = Observability()
+    led = obs.devledger
+
     # compile + warm outside every timed loop (shapes are shared across
-    # disciplines, so this is the only compilation in the process)
-    ref = sharded_frontier_passes(mesh, grid)
-    sharded_frontier_passes(mesh, grid)
+    # disciplines, so this is the only compilation in the process). The
+    # device-time ledger watches the warmup so every legitimate compile
+    # lands here; anything after the baseline below is a silent retrace.
+    with led.activate("sharded"):
+        ref = sharded_frontier_passes(mesh, grid)
+        sharded_frontier_passes(mesh, grid)
+    retrace_base = retrace_baseline(obs)
 
     results = {}
     blocked = {}
@@ -122,7 +146,8 @@ def main(argv=None):
     for _ in range(CALLS):
         gossip_stage()
         tb = time.perf_counter()
-        out = sharded_frontier_passes(mesh, grid)
+        with led.activate("sharded"):
+            out = sharded_frontier_passes(mesh, grid)
         b += time.perf_counter() - tb
     results["sync"] = time.perf_counter() - t0
     blocked["sync"] = b
@@ -133,7 +158,7 @@ def main(argv=None):
     prev = None
     for _ in range(CALLS):
         gossip_stage()
-        task = _AsyncPass(mesh, grid)
+        task = _AsyncPass(mesh, grid, ledger=led)
         if prev is not None:
             tb = time.perf_counter()
             out = prev.result()
@@ -161,7 +186,7 @@ def main(argv=None):
             # one dispatch covers every pending sync: the one-shot
             # restage stages the whole graph, so integration of this
             # result lands the rounds for all of them at once
-            inflight.append(_AsyncPass(mesh, grid))
+            inflight.append(_AsyncPass(mesh, grid, ledger=led))
             pending = 0
     while inflight:
         tb = time.perf_counter()
@@ -169,6 +194,11 @@ def main(argv=None):
         b += time.perf_counter() - tb
     results["queued_mesh"] = time.perf_counter() - t0
     blocked["queued_mesh"] = b
+
+    # steady-state retrace budget (ISSUE 19): shapes are shared across
+    # disciplines, so after the warmup the compile cache must serve every
+    # timed call — any retrace here is a staging bug
+    retraces = retrace_delta(obs, retrace_base)
 
     # correctness gate: dispatch discipline must not change results
     np.testing.assert_array_equal(np.asarray(out.rounds), np.asarray(ref.rounds))
@@ -193,9 +223,6 @@ def main(argv=None):
         f"dispatch disciplines out of order: {eps}"
     )
 
-    from babble_tpu.obs import Observability, log_buckets
-
-    obs = Observability()
     lat = obs.histogram(
         "babble_bench_dispatch_blocked_seconds",
         "Blocked device wall time per gossip sync, by dispatch discipline",
@@ -210,7 +237,15 @@ def main(argv=None):
     for name in disciplines:
         lat.labels(path=name).observe(blocked[name] / CALLS)
         thr.labels(path=name).set(eps[name])
+    # SLO-visible gauge for the retrace budget (the objective below
+    # reads it; operators see the same series on /metrics)
+    obs.gauge(
+        "babble_bench_retrace_delta",
+        "Steady-state kernel retraces past the warmup baseline "
+        "(budget: zero)",
+    ).set(float(sum(retraces.values())))
 
+    led_snap = led.snapshot()
     print(
         json.dumps(
             {
@@ -226,6 +261,16 @@ def main(argv=None):
                     eps["queued_mesh"] / max(eps["sync"], 1e-9), 2
                 ),
                 "disciplines": disciplines,
+                "ledger": {
+                    "shares": led_snap["shares"],
+                    "compiles": sum(
+                        e["compiles"] for e in led_snap["entries"].values()
+                    ),
+                    "retraces": sum(
+                        e["retraces"] for e in led_snap["entries"].values()
+                    ),
+                    "retrace_delta": retraces,
+                },
                 "metrics": obs.registry.snapshot(),
             }
         )
@@ -239,10 +284,31 @@ def main(argv=None):
             file=sys.stderr,
         )
         if not ok:
+            if retraces:
+                # name the offending entry points and dump the flight
+                # ring — the last dispatch lifecycle records are the
+                # context an operator needs to see WHICH dispatch pattern
+                # forced the rebuild
+                print(
+                    "RETRACE BUDGET BLOWN: "
+                    + ", ".join(
+                        f"{e} (+{int(d)})"
+                        for e, d in sorted(retraces.items())
+                    ),
+                    file=sys.stderr,
+                )
+                print(
+                    "flight ring: "
+                    + json.dumps(obs.flightrec.to_json(), sort_keys=True),
+                    file=sys.stderr,
+                )
             print(
                 f"SLO BREACH: queued_mesh blocked "
                 f"{disciplines['queued_mesh']['ms_per_call']} ms/call over "
-                f"the {args.slo_max_blocked_ms} ms ceiling",
+                f"the {args.slo_max_blocked_ms} ms ceiling"
+                if disciplines["queued_mesh"]["ms_per_call"]
+                > args.slo_max_blocked_ms
+                else "SLO BREACH: steady-state retrace budget exceeded",
                 file=sys.stderr,
             )
             return 1

@@ -39,27 +39,35 @@ SEED = 0
 TARGET = 100_000.0
 
 
-def _run_train_mode(grid, trains, e_cap):
+def _run_train_mode(grid, trains, e_cap, obs):
     """Level-scan incremental engine (incremental.py Train path)."""
     import jax.numpy as jnp
     import numpy as np
 
+    from babble_tpu.obs import ledger_call
     from babble_tpu.tpu.incremental import init_state, train_step
 
+    led = obs.devledger
     r_cap = 64
     state = init_state(grid.n, e_cap, r_cap)
-    for t in trains:
-        state = train_step(state, t, grid.super_majority, grid.n, e_win=E_WIN)
+    with led.activate("incremental"):
+        for t in trains:
+            state = ledger_call(
+                "train_step", train_step, state, t, grid.super_majority,
+                grid.n, e_win=E_WIN,
+            )
     np.asarray(state.rounds)  # sync (compile + chip ramp)
 
     elapsed = float("inf")
     for _ in range(3):
         state = init_state(grid.n, e_cap, r_cap)
         start = time.perf_counter()
-        for t in trains:
-            state = train_step(
-                state, t, grid.super_majority, grid.n, e_win=E_WIN
-            )
+        with led.activate("incremental"):
+            for t in trains:
+                state = ledger_call(
+                    "train_step", train_step, state, t,
+                    grid.super_majority, grid.n, e_win=E_WIN,
+                )
         acc = int(np.asarray(
             state.last_round + jnp.sum(state.rounds) + jnp.sum(state.received)
         ))
@@ -69,12 +77,13 @@ def _run_train_mode(grid, trains, e_cap):
     return state, elapsed, "train dispatch (level scan)"
 
 
-def _run_frontier_mode(grid, trains, e_cap):
+def _run_frontier_mode(grid, trains, e_cap, obs):
     """Frontier-live engine: incrementally-maintained INV/chain tables +
     the round-frontier walk per train (frontier_live.py)."""
     import jax.numpy as jnp
     import numpy as np
 
+    from babble_tpu.obs import ledger_call
     from babble_tpu.tpu.frontier_live import (
         frontier_train_step, init_frontier_state,
     )
@@ -85,17 +94,25 @@ def _run_frontier_mode(grid, trains, e_cap):
     #              a visible failure
     sm, n = grid.super_majority, grid.n
 
+    led = obs.devledger
     state = init_frontier_state(n, e_cap, l_cap, r_cap)
-    for t in trains:
-        state = frontier_train_step(state, t, sm, n)
+    with led.activate("frontier_live"):
+        for t in trains:
+            state = ledger_call(
+                "frontier_train_step", frontier_train_step, state, t, sm, n,
+            )
     np.asarray(state.rounds)  # sync (compile + chip ramp)
 
     elapsed = float("inf")
     for _ in range(3):
         state = init_frontier_state(n, e_cap, l_cap, r_cap)
         start = time.perf_counter()
-        for t in trains:
-            state = frontier_train_step(state, t, sm, n)
+        with led.activate("frontier_live"):
+            for t in trains:
+                state = ledger_call(
+                    "frontier_train_step", frontier_train_step, state, t,
+                    sm, n,
+                )
         acc = int(np.asarray(
             state.last_round + jnp.sum(state.rounds) + jnp.sum(state.received)
         ))
@@ -122,9 +139,15 @@ def main():
         for t in trains_from_grid(grid, TRAIN, UPD_CAP, e_cap, t_cap=T_CAP)
     ]
 
+    # obs built before the timed run so the device-time ledger can seam
+    # the per-train entry points (ISSUE 19)
+    from babble_tpu.obs import Observability, log_buckets
+
+    obs = Observability()
+
     mode = os.environ.get("BENCH_INC_MODE", "frontier")
     runner = _run_frontier_mode if mode == "frontier" else _run_train_mode
-    state, elapsed, label = runner(grid, trains, e_cap)
+    state, elapsed, label = runner(grid, trains, e_cap, obs)
     events_per_sec = grid.e / elapsed
 
     # differential gate vs the one-shot pipeline
@@ -139,9 +162,6 @@ def main():
     assert int(state.last_round) == ref.last_round
 
     # obs-layer registry view of the run, embedded in the headline
-    from babble_tpu.obs import Observability, log_buckets
-
-    obs = Observability()
     obs.histogram(
         "babble_bench_iteration_seconds",
         "Per-train wall time of the append-mode benchmark",
@@ -152,6 +172,7 @@ def main():
         "Benchmark throughput headline",
     ).set(events_per_sec)
 
+    led_snap = obs.devledger.snapshot()
     print(
         json.dumps(
             {
@@ -163,6 +184,15 @@ def main():
                 "value": round(events_per_sec, 1),
                 "unit": "events/s",
                 "vs_baseline": round(events_per_sec / TARGET, 3),
+                "ledger": {
+                    "shares": led_snap["shares"],
+                    "compiles": sum(
+                        e["compiles"] for e in led_snap["entries"].values()
+                    ),
+                    "retraces": sum(
+                        e["retraces"] for e in led_snap["entries"].values()
+                    ),
+                },
                 "metrics": obs.registry.snapshot(),
             }
         )

@@ -122,6 +122,16 @@ def slo_gate(obs, min_rounds: float, packed_floor=None, packed_n=None):
                         "much faster than the wide layout at the "
                         "largest crossover-scale rung",
         )
+    # steady-state retrace budget (ISSUE 19): zero kernel retraces past
+    # each sweep point's warmup — nonzero means a staged callable is
+    # being silently rebuilt inside the timed loops
+    slo.objective(
+        "retrace_budget",
+        series="babble_bench_retrace_delta",
+        kind="below", threshold=1.0,
+        description="steady-state kernel retraces past warmup stay at "
+                    "zero",
+    )
     status = slo.evaluate()
     return not slo.breached(), status
 
@@ -149,12 +159,22 @@ def build_mesh(devices, validator_shards):
 def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
     """One validator count: build the grid, gate every discipline against
     the CPU oracle, return the per-discipline numbers."""
+    import contextlib
+
     import numpy as np
 
+    from babble_tpu.obs import retrace_baseline, retrace_delta
     from babble_tpu.tpu.dispatch import _AsyncPass
     from babble_tpu.tpu.engine import run_frontier_passes
     from babble_tpu.tpu.grid import build_levels, synthetic_grid
     from babble_tpu.tpu.sharded import sharded_frontier_passes
+
+    led = obs.devledger if obs is not None else None
+
+    def act(layout="wide"):
+        if led is None:
+            return contextlib.nullcontext()
+        return led.activate("sharded", layout=layout)
 
     grid = synthetic_grid(n, events, seed=SEED)
     ref = run_frontier_passes(grid)  # CPU oracle
@@ -179,10 +199,16 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
 
     # compile + warm every device path outside the timed loops; the
     # packed warm call doubles as the per-point byte-equality gate the
-    # ISSUE 17 discipline requires (gate() bisects on divergence)
-    gate(sharded_frontier_passes(mesh, grid))
-    gate(sharded_frontier_passes(mesh, grid, packed=True))
-    gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())
+    # ISSUE 17 discipline requires (gate() bisects on divergence). The
+    # device-time ledger watches the warmup so every legitimate compile
+    # lands before the retrace baseline below.
+    with act():
+        gate(sharded_frontier_passes(mesh, grid))
+    with act(layout="packed"):
+        gate(sharded_frontier_passes(mesh, grid, packed=True))
+    gate(_AsyncPass(mesh, grid, prefer_doubling=True, ledger=led).result())
+    retrace_base = retrace_baseline(obs) if obs is not None else {}
+    cells0 = led.snapshot()["cells"] if led is not None else {}
 
     wall, blocked, dispatches = {}, {}, {}
 
@@ -192,7 +218,8 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
     for _ in range(CALLS):
         gossip_stage()
         tb = time.perf_counter()
-        out = sharded_frontier_passes(mesh, grid)
+        with act():
+            out = sharded_frontier_passes(mesh, grid)
         b += time.perf_counter() - tb
     wall["sync"] = time.perf_counter() - t0
     blocked["sync"], dispatches["sync"] = b, CALLS
@@ -203,7 +230,8 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
     for _ in range(CALLS):
         gossip_stage()
         tb = time.perf_counter()
-        out = sharded_frontier_passes(mesh, grid, packed=True)
+        with act(layout="packed"):
+            out = sharded_frontier_passes(mesh, grid, packed=True)
         b += time.perf_counter() - tb
     gate(out)
     wall["packed"] = time.perf_counter() - t0
@@ -219,7 +247,7 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
             tb = time.perf_counter()
             out = inflight.pop(0).result()
             b += time.perf_counter() - tb
-        inflight.append(_AsyncPass(mesh, grid))
+        inflight.append(_AsyncPass(mesh, grid, ledger=led))
     while inflight:
         tb = time.perf_counter()
         out = inflight.pop(0).result()
@@ -243,11 +271,15 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
             tb = time.perf_counter()
             out = inflight.pop(0).result()
             b += time.perf_counter() - tb
-        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        inflight.append(
+            _AsyncPass(mesh, grid, prefer_doubling=True, ledger=led)
+        )
         n_disp += 1
         pending = 0
     if pending:
-        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        inflight.append(
+            _AsyncPass(mesh, grid, prefer_doubling=True, ledger=led)
+        )
         n_disp += 1
     while inflight:
         tb = time.perf_counter()
@@ -288,10 +320,30 @@ def run_sweep_point(mesh, n, events, oracle_cache, obs=None):
     point["packed"]["table_bytes"] = tb_packed
     point["packed"]["table_bytes_wide"] = tb_wide
     point["packed"]["table_bytes_reduction"] = round(tb_wide / tb_packed, 2)
+    if led is not None:
+        # per-point device-time ledger (ISSUE 19): this sweep point's
+        # share of attributed seconds per (rung, pass, layout) — the
+        # cumulative cells diffed against the point's post-warmup state
+        cells1 = led.snapshot()["cells"]
+        delta_s = {}
+        for key, (_calls, secs) in cells1.items():
+            prev = cells0.get(key, (0, 0.0))[1]
+            d = secs - prev
+            if d > 0:
+                delta_s[key] = d
+        total_s = sum(delta_s.values())
+        point["ledger"] = {
+            "seconds": round(total_s, 6),
+            "shares": {
+                k: round(v / total_s, 4) if total_s > 0 else 0.0
+                for k, v in sorted(delta_s.items())
+            },
+            "retrace_delta": retrace_delta(obs, retrace_base),
+        }
     return point
 
 
-def run_catchup_anchor(mesh, events, rpd_hist):
+def run_catchup_anchor(mesh, events, rpd_hist, obs=None):
     """Deep catch-up stream: one grid of ~events/ANCHOR_N generations
     replayed through the round-batched discipline only. Every dispatch's
     round coverage is observed into rpd_hist — this is the series the
@@ -302,6 +354,7 @@ def run_catchup_anchor(mesh, events, rpd_hist):
     from babble_tpu.tpu.engine import run_frontier_passes
     from babble_tpu.tpu.grid import synthetic_grid
 
+    led = obs.devledger if obs is not None else None
     grid = synthetic_grid(ANCHOR_N, events, seed=SEED)
     ref = run_frontier_passes(grid)
     total_rounds = int(ref.last_round) + 1
@@ -319,7 +372,7 @@ def run_catchup_anchor(mesh, events, rpd_hist):
             _bisect_gate(grid, out, ref, "mesh-catchup-anchor")
             raise
 
-    gate(_AsyncPass(mesh, grid, prefer_doubling=True).result())  # compile
+    gate(_AsyncPass(mesh, grid, prefer_doubling=True, ledger=led).result())  # compile
 
     t0 = time.perf_counter()
     b = 0.0
@@ -335,11 +388,11 @@ def run_catchup_anchor(mesh, events, rpd_hist):
             tb = time.perf_counter()
             out = inflight.pop(0).result()
             b += time.perf_counter() - tb
-        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True, ledger=led))
         n_disp += 1
         pending = 0
     if pending:
-        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True))
+        inflight.append(_AsyncPass(mesh, grid, prefer_doubling=True, ledger=led))
         n_disp += 1
     while inflight:
         tb = time.perf_counter()
@@ -482,7 +535,22 @@ def main(argv=None):
 
     anchor = None
     if args.anchor_events:
-        anchor = run_catchup_anchor(mesh, args.anchor_events, rpd)
+        anchor = run_catchup_anchor(mesh, args.anchor_events, rpd, obs)
+
+    # steady-state retrace budget across the whole sweep: each point's
+    # delta is measured against its own post-warmup baseline, so fresh
+    # compiles at new shapes never count — only silent rebuilds do
+    retraces = {}
+    for point in per_n.values():
+        for entry, d in point.get("ledger", {}).get(
+            "retrace_delta", {}
+        ).items():
+            retraces[entry] = retraces.get(entry, 0.0) + d
+    obs.gauge(
+        "babble_bench_retrace_delta",
+        "Steady-state kernel retraces past the warmup baseline "
+        "(budget: zero)",
+    ).set(float(sum(retraces.values())))
 
     top = per_n[str(sweep[-1])]
     headline_rpd = (
@@ -534,6 +602,20 @@ def main(argv=None):
             breached = [
                 o["name"] for o in status["objectives"] if o["breached"]
             ]
+            if retraces and "retrace_budget" in breached:
+                print(
+                    "RETRACE BUDGET BLOWN: "
+                    + ", ".join(
+                        f"{e} (+{int(d)})"
+                        for e, d in sorted(retraces.items())
+                    ),
+                    file=sys.stderr,
+                )
+                print(
+                    "flight ring: "
+                    + json.dumps(obs.flightrec.to_json(), sort_keys=True),
+                    file=sys.stderr,
+                )
             print(
                 f"SLO BREACH ({', '.join(breached)}): round-batched "
                 f"dispatches covered {headline_rpd} rounds/dispatch "

@@ -106,7 +106,9 @@ def ingest_p99_value(doc):
 
 
 def load_series(pattern, extract):
-    """[(round, value-or-None)] sorted by round, one entry per artifact."""
+    """[(round, value-or-None, doc-or-None)] sorted by round, one entry
+    per artifact. The doc rides along so a regression verdict can read
+    the headline's device-time ledger for attribution."""
     series = []
     for path in sorted(glob.glob(os.path.join(ROOT, pattern)), key=_round_of):
         try:
@@ -114,28 +116,69 @@ def load_series(pattern, extract):
                 doc = json.load(f)
         except (OSError, ValueError) as e:
             print(f"trend: unreadable {os.path.basename(path)}: {e}")
-            series.append((_round_of(path), None))
+            series.append((_round_of(path), None, None))
             continue
-        series.append((_round_of(path), extract(doc)))
+        series.append((_round_of(path), extract(doc), doc))
     return series
+
+
+def ledger_shares(doc):
+    """Per-(rung, pass, layout) share map from an artifact's headline
+    ledger (ISSUE 19), or None when the round predates the ledger."""
+    if not isinstance(doc, dict):
+        return None
+    headline = _last_json_line(doc.get("tail"))
+    if not headline:
+        return None
+    ledger = headline.get("ledger")
+    if not isinstance(ledger, dict):
+        return None
+    shares = ledger.get("shares")
+    return shares if isinstance(shares, dict) else None
+
+
+def attribute_regression(latest_doc, prior_doc):
+    """Name the (rung, pass) whose ledger share moved most between the
+    best prior round and the regressed latest round. Returns
+    (cell_key, delta, latest_share, prior_share) or None when either
+    round carries no ledger."""
+    latest = ledger_shares(latest_doc)
+    prior = ledger_shares(prior_doc)
+    if not latest or not prior:
+        return None
+    movers = []
+    for key in set(latest) | set(prior):
+        a = float(prior.get(key, 0.0))
+        b = float(latest.get(key, 0.0))
+        movers.append((abs(b - a), key, b - a, b, a))
+    movers.sort(reverse=True)
+    if not movers or movers[0][0] == 0.0:
+        return None
+    _mag, key, delta, b, a = movers[0]
+    return key, delta, b, a
 
 
 def check(name, series, unit, better):
     """Print one trajectory; return False when the latest valid round is
     >10% worse than the best prior valid round. `better` is max for
-    higher-is-better series, min for lower-is-better."""
-    valid = [(r, v) for r, v in series if v is not None]
+    higher-is-better series, min for lower-is-better. On a regression,
+    diff the latest round's device-time ledger against the best prior
+    round's and name the (rung, pass) whose share moved most."""
+    valid = [(r, v, d) for r, v, d in series if v is not None]
     line = "  " + " -> ".join(
         f"r{r:02d}:{v:g}" if v is not None else f"r{r:02d}:-"
-        for r, v in series
+        for r, v, _d in series
     )
     print(f"{name} ({unit}):")
     print(line if series else "  (no artifacts)")
     if len(valid) < 2:
         print("  fewer than two valid rounds — nothing to gate")
         return True
-    latest_r, latest = valid[-1]
-    best = better(v for _, v in valid[:-1])
+    latest_r, latest, latest_doc = valid[-1]
+    best_r, best, best_doc = (
+        max(valid[:-1], key=lambda t: t[1]) if better is max
+        else min(valid[:-1], key=lambda t: t[1])
+    )
     if better is max:
         ok = latest >= best * (1.0 - REGRESSION_TOLERANCE)
         rel = latest / best - 1.0
@@ -147,6 +190,20 @@ def check(name, series, unit, better):
         f"  latest r{latest_r:02d} = {latest:g} vs best prior {best:g} "
         f"({rel:+.1%}): {verdict}"
     )
+    if not ok:
+        attr = attribute_regression(latest_doc, best_doc)
+        if attr is not None:
+            key, delta, b, a = attr
+            print(
+                f"  attribution: ledger share of {key} moved "
+                f"{delta:+.1%} (r{best_r:02d} {a:.1%} -> "
+                f"r{latest_r:02d} {b:.1%}) — the pass to profile first"
+            )
+        else:
+            print(
+                "  attribution: no device-time ledger in one or both "
+                "rounds — rerun the bench to get per-pass shares"
+            )
     return ok
 
 

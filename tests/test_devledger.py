@@ -1,0 +1,345 @@
+"""Device-time ledger tests (ISSUE 19): contract-surface coverage of
+ENTRY_INFO, seam cell/compile/retrace accounting over real jit
+callables, the sim-clock determinism contract (byte-identical
+fingerprints), the knob-flip recompile budget, the seeded-retrace
+fixture that must trip the steady-state budget gate, the unified
+host+device Chrome-trace timeline, and the bench-trend regression
+attribution helper."""
+
+import glob
+import json
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from babble_tpu.obs import (
+    ENTRY_INFO,
+    Observability,
+    SLOEngine,
+    build_timeline,
+    ledger_call,
+    retrace_baseline,
+    retrace_delta,
+)
+from babble_tpu.common import SystemClock
+from babble_tpu.sim import SimClock, run_one
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+
+# ----------------------------------------------------------------------
+# contract-surface coverage
+# ----------------------------------------------------------------------
+
+def test_entry_info_covers_kernel_contract_surface():
+    """Every `# kernel-contract:` entry point in tpu/ has a ledger seam
+    or a covered_by pointer — and nothing else does. A new staged kernel
+    cannot land without joining the ledger's attribution map."""
+    marked = set()
+    for path in glob.glob(os.path.join(ROOT, "babble_tpu", "tpu", "*.py")):
+        with open(path) as f:
+            for line in f:
+                m = re.search(r"#\s*kernel-contract:\s*(\w+)", line)
+                if m:
+                    marked.add(m.group(1))
+    assert marked == set(ENTRY_INFO), (
+        f"missing from ENTRY_INFO: {sorted(marked - set(ENTRY_INFO))}; "
+        f"stale in ENTRY_INFO: {sorted(set(ENTRY_INFO) - marked)}"
+    )
+    # covered_by pointers must reference real seam entries
+    for entry, (_rung, _pass, covered_by) in ENTRY_INFO.items():
+        if covered_by is not None:
+            assert covered_by in ENTRY_INFO, (entry, covered_by)
+            assert ENTRY_INFO[covered_by][2] is None, (
+                f"{entry} covered by {covered_by}, which is itself covered"
+            )
+
+
+# ----------------------------------------------------------------------
+# seam accounting
+# ----------------------------------------------------------------------
+
+def test_seam_records_cells_compiles_and_metrics():
+    obs = Observability()
+    led = obs.devledger
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(8, dtype=jnp.int32)
+    with led.activate("oneshot"):
+        ledger_call("consensus_pipeline", f, x)
+        ledger_call("consensus_pipeline", f, x)
+    snap = led.snapshot()
+    cells = snap["cells"]
+    # first call compiled, second ran from cache
+    assert cells["oneshot/pipeline/wide/compile"][0] == 1
+    assert cells["oneshot/pipeline/wide/run"][0] == 1
+    est = snap["entries"]["consensus_pipeline"]
+    assert est["calls"] == 2
+    assert est["compiles"] == 1
+    assert est["retraces"] == 0
+    assert est["bytes_in"] == 2 * 8 * 4
+    # shares sum to 1 over the recorded cells
+    assert abs(sum(snap["shares"].values()) - 1.0) < 1e-6
+    # the typed metric surface materialized
+    assert obs.registry.get("babble_kernel_pass_seconds") is not None
+    c = obs.registry.get("babble_kernel_compiles_total")
+    assert c.value(entry="consensus_pipeline") == 1.0
+
+
+def test_uninstrumented_passthrough_without_activation():
+    """ledger_call outside any activation is a pure passthrough — deep
+    tpu/ call sites never need an obs handle to stay callable."""
+    f = jax.jit(lambda x: x - 3)
+    out = ledger_call("_step_full", f, jnp.int32(7))
+    assert int(out) == 4
+
+
+def test_lifecycle_component_cells():
+    obs = Observability()
+    led = obs.devledger
+    led.component("mesh_queued", "stage", 0.25, layout="packed")
+    led.component("mesh_queued", "fetch", 0.5, layout="packed")
+    cells = led.snapshot()["cells"]
+    assert cells["mesh_queued/dispatch/packed/stage"] == [1, 0.25]
+    assert cells["mesh_queued/dispatch/packed/fetch"] == [1, 0.5]
+
+
+# ----------------------------------------------------------------------
+# determinism: the sim clock policy
+# ----------------------------------------------------------------------
+
+def _seamed_run(obs):
+    led = obs.devledger
+    f = jax.jit(lambda x: x + 1)
+    with led.activate("oneshot"):
+        for _ in range(3):
+            ledger_call("consensus_pipeline", f, jnp.arange(4))
+    led.component("oneshot", "integrate", 0.0)
+    return led
+
+
+def test_sim_clock_records_zero_and_identical_fingerprints():
+    """Under any non-system clock every duration is identically 0.0 —
+    the ledger never reads a virtual clock (SimClock is serve-thread
+    only) and same-seed snapshots stay byte-identical."""
+    a = _seamed_run(Observability(clock=SimClock()))
+    b = _seamed_run(Observability(clock=SimClock()))
+    snap = a.snapshot()
+    assert snap["total_seconds"] == 0.0
+    assert all(secs == 0.0 for _n, secs in snap["cells"].values())
+    assert a.fingerprint() == b.fingerprint()
+    # the real clock records nonzero time for the same run, under the
+    # same cell names
+    real = _seamed_run(Observability(clock=SystemClock()))
+    assert set(real.snapshot()["cells"]) == set(snap["cells"])
+    assert real.snapshot()["total_seconds"] > 0.0
+
+
+def test_sim_cluster_ledger_fingerprint_deterministic():
+    """ledger_fingerprint joins the SimCluster determinism contract:
+    same seed+plan twice => byte-identical ledgers on every node."""
+    a = run_one(5, plan="clean", n=4, until=None, target_block=2)
+    b = run_one(5, plan="clean", n=4, until=None, target_block=2)
+    assert a["ok"] and b["ok"]
+    assert "ledger_fingerprint" in a
+    assert a["ledger_fingerprint"] == b["ledger_fingerprint"]
+
+
+# ----------------------------------------------------------------------
+# knob-flip and retrace budgets
+# ----------------------------------------------------------------------
+
+def test_knob_flip_recompiles_without_retraces():
+    """Flipping packed_voting mid-session changes the layout half of the
+    seam signature: exactly one fresh compile per layout, zero silent
+    retraces — the dispatch-time layout resolution (tpu/packed.py)
+    exists to keep it that way."""
+    obs = Observability()
+    led = obs.devledger
+    f = jax.jit(lambda x: jnp.sum(x))
+    x = jnp.arange(16)
+    for layout in ("wide", "packed", "wide", "packed"):
+        with led.activate("sharded", layout=layout):
+            ledger_call("local_fame", f, x)
+    est = led.entry_stats("local_fame")
+    assert est["compiles"] == 1  # one XLA executable serves both layouts
+    assert est["retraces"] == 0
+    cells = led.snapshot()["cells"]
+    assert cells["sharded/fame/wide/compile"][0] == 1
+    assert cells["sharded/fame/wide/run"][0] == 1
+    assert cells["sharded/fame/packed/run"][0] == 2
+
+
+def test_seeded_retrace_fixture_trips_budget_gate():
+    """A fresh jit wrapper per call on an already-seen signature is the
+    silent-retrace pathology: the ledger must count it, retrace_delta
+    must name the entry, and the SLO-style budget gate must breach."""
+    obs = Observability()
+    led = obs.devledger
+
+    def fresh_wrapper():
+        return jax.jit(lambda x: x * 3)
+
+    with led.activate("incremental"):
+        ledger_call("_step_full", fresh_wrapper(), jnp.arange(4))
+    base = retrace_baseline(obs)
+    with led.activate("incremental"):
+        for _ in range(2):
+            ledger_call("_step_full", fresh_wrapper(), jnp.arange(4))
+    delta = retrace_delta(obs, base)
+    assert delta == {"_step_full": 2.0}
+    # the gate a queued-mesh bench runs under --slo (bench_dispatch.py)
+    obs.gauge(
+        "babble_bench_retrace_delta",
+        "Steady-state kernel retraces past the warmup baseline "
+        "(budget: zero)",
+    ).set(float(sum(delta.values())))
+    obs.flightrec.record("dispatch.enqueue", events=4, depth=1)
+    slo = SLOEngine(obs)
+    slo.objective(
+        "retrace_budget",
+        series="babble_bench_retrace_delta",
+        kind="below", threshold=1.0,
+        description="steady-state kernel retraces past warmup stay at "
+                    "zero",
+    )
+    slo.evaluate()
+    assert slo.breached()
+    # the flight ring the breach handler dumps is serializable and
+    # carries the dispatch lifecycle context
+    ring = json.dumps(obs.flightrec.to_json(), sort_keys=True)
+    assert "dispatch.enqueue" in ring
+
+
+# ----------------------------------------------------------------------
+# unified timeline
+# ----------------------------------------------------------------------
+
+def test_timeline_is_valid_chrome_trace():
+    obs = Observability()
+    led = obs.devledger
+    with obs.tracer.span("serve"):
+        with led.activate("frontier"):
+            ledger_call(
+                "frontier_pipeline", jax.jit(lambda x: x + 1), jnp.arange(4)
+            )
+    obs.flightrec.record("dispatch.enqueue", events=4, depth=1)
+    obs.flightrec.record("dispatch.integrate", blocked=0.01, depth=0)
+    doc = build_timeline(obs)
+    json.loads(json.dumps(doc))  # round-trips as JSON
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for ev in evs:
+        assert {"ph", "pid", "name"} <= set(ev), ev
+        if ev["ph"] in ("X", "i", "C"):
+            assert "ts" in ev, ev
+        if ev["ph"] == "X":
+            assert "dur" in ev, ev
+    # host lane, device pass lane, and queue lane all present
+    assert any(e["ph"] == "X" and e["name"] == "serve" for e in evs)
+    device = [
+        e for e in evs
+        if e["ph"] == "X" and e["name"] == "frontier_pipeline[wide]"
+    ]
+    assert device and device[0]["args"]["compiles"] >= 1
+    lanes = {
+        e["args"]["name"] for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "device:frontier/pipeline" in lanes
+    assert any(e["ph"] == "i" for e in evs)  # dispatch instants
+    assert any(
+        e["ph"] == "C" and e["name"] == "queue_depth" for e in evs
+    )
+
+
+def test_service_serves_timeline_and_ledger_stats():
+    """GET /debug/timeline returns the merged Chrome-trace document over
+    a live node, and /stats carries the ledger adapter keys once device
+    passes have been ledgered."""
+    import urllib.request
+
+    from babble_tpu.service import Service
+
+    from test_node import init_nodes, run_nodes, shutdown_nodes
+
+    nodes, _proxies = init_nodes(2)
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        run_nodes(nodes)
+        svc.serve()
+        base = f"http://{svc.local_addr()}"
+        # ledger a pass directly — the endpoint contract is independent
+        # of whether this node's workload reached a device rung
+        led = nodes[0].obs.devledger
+        with led.activate("oneshot"):
+            ledger_call(
+                "consensus_pipeline", jax.jit(lambda x: x + 1),
+                jnp.arange(4),
+            )
+        led.component("oneshot", "integrate", 0.001)
+        with urllib.request.urlopen(base + "/debug/timeline", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["displayTimeUnit"] == "ms"
+        assert any(
+            e["ph"] == "M" and e["args"].get("name")
+            == "device:oneshot/pipeline"
+            for e in doc["traceEvents"]
+        )
+        with urllib.request.urlopen(base + "/stats", timeout=5) as r:
+            stats = json.loads(r.read())
+        assert "ledger_ms_oneshot_pipeline" in stats
+        assert stats["kernel_compiles"] == "1"
+        assert stats["kernel_retraces"] == "0"
+    finally:
+        svc.shutdown()
+        shutdown_nodes(nodes)
+
+
+# ----------------------------------------------------------------------
+# trend attribution
+# ----------------------------------------------------------------------
+
+def _artifact(value, shares):
+    headline = {
+        "value": value, "unit": "ms/call",
+        "ledger": {"shares": shares},
+    }
+    return {"rc": 0, "ok": True, "tail": "noise\n" + json.dumps(headline)}
+
+
+def test_trend_attribution_names_moved_pass():
+    """A synthetic 20% regression whose extra milliseconds sit in the
+    queued rung's run phase must be attributed to exactly that (rung,
+    pass) by the bench_trend helper."""
+    import bench_trend
+
+    prior = _artifact(50.0, {
+        "mesh_queued/walk/wide": 0.50,
+        "mesh_queued/fame/wide": 0.30,
+        "mesh_queued/rounds/wide": 0.20,
+    })
+    latest = _artifact(60.0, {  # 20% worse, walk's share ballooned
+        "mesh_queued/walk/wide": 0.65,
+        "mesh_queued/fame/wide": 0.22,
+        "mesh_queued/rounds/wide": 0.13,
+    })
+    attr = bench_trend.attribute_regression(latest, prior)
+    assert attr is not None
+    key, delta, latest_share, prior_share = attr
+    assert key == "mesh_queued/walk/wide"
+    assert delta > 0.10
+    assert latest_share == 0.65 and prior_share == 0.50
+    # rounds that predate the ledger degrade to None, not a crash
+    assert bench_trend.attribute_regression(
+        latest, {"rc": 0, "tail": json.dumps({"value": 1.0})}
+    ) is None
+    assert bench_trend.ledger_shares(prior) == {
+        "mesh_queued/walk/wide": 0.50,
+        "mesh_queued/fame/wide": 0.30,
+        "mesh_queued/rounds/wide": 0.20,
+    }
