@@ -4,7 +4,7 @@
 PY ?= python3
 N ?= 4
 
-.PHONY: test lint race bench bench-mesh bench-ingest bench-packed trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
+.PHONY: test lint race status-smoke bench bench-mesh bench-ingest bench-packed trend soak dist wheel-proof demo-conf demo demo-watch demo-bombard multichip version
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -45,6 +45,13 @@ lint:
 	else \
 		echo "lint: mypy not installed — skipping advisory tier"; \
 	fi
+
+# cluster health plane end-to-end (ISSUE 20, docs/observability.md):
+# 3-node in-proc cluster -> digest piggyback over live gossip -> GET
+# /debug/cluster + /health/digest over TCP -> the `babble-tpu status`
+# renderer must show 3 nodes at zero skew, full agreement, no suspicion
+status-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/status_smoke.py
 
 bench:
 	$(PY) bench.py

@@ -44,6 +44,13 @@ Rules (waiver tag `obs-ok`):
   provenance stream's determinism fingerprint, which joins the sim's
   byte-identical-replay contract (docs/sim.md) — the same reasoning as
   flight-recorder record names.
+- obs-cluster-static-name — a cluster-observatory query or flag
+  (`*.series_value/flag(...)` on a clusterview receiver) whose name is
+  not a string literal.  Derived cluster-series names feed the series
+  catalog in docs/observability.md and the sim's cluster-health
+  determinism fingerprint (docs/sim.md); flag names join the flight-
+  record catalog — a computed name breaks all three, exactly as for
+  metric and record names.
 - obs-ledger-static-name — a device-time ledger emission whose entry,
   rung or component name is not a string literal: `ledger_call(entry,
   fn, ...)` anywhere, and `*.call/activate/component(...)` on a
@@ -86,6 +93,9 @@ SLO_RECEIVER_TAILS = {"slo"}
 
 PROV_METHODS = {"mark"}
 PROV_RECEIVER_TAILS = {"provenance", "prov"}
+
+CLUSTER_METHODS = {"series_value", "flag"}
+CLUSTER_RECEIVER_TAILS = {"clusterview", "cv"}
 
 LEDGER_METHODS = {"call", "activate", "component"}
 LEDGER_RECEIVER_TAILS = {"devledger", "ledger", "led", "_led", "_ledger"}
@@ -159,6 +169,16 @@ def _prov_receiver(func: ast.Attribute) -> Optional[str]:
     return recv if tail in PROV_RECEIVER_TAILS else None
 
 
+def _cluster_receiver(func: ast.Attribute) -> Optional[str]:
+    """The receiver chain of a cluster-observatory call, or None when
+    this is not an observatory call we police (e.g. `df.flag(...)`)."""
+    recv = dotted_name(func.value)
+    if recv is None:
+        return None
+    tail = recv.rsplit(".", 1)[-1]
+    return recv if tail in CLUSTER_RECEIVER_TAILS else None
+
+
 def _ledger_receiver(func: ast.Attribute) -> Optional[str]:
     """The receiver chain of a ledger emission, or None when this is
     not a ledger call we police (e.g. `queue.call(...)`)."""
@@ -216,6 +236,10 @@ class _ObsVisitor(SymbolTracker):
             recv = _prov_receiver(func)
             if recv is not None:
                 self._check_prov(node, recv, func.attr)
+        if isinstance(func, ast.Attribute) and func.attr in CLUSTER_METHODS:
+            recv = _cluster_receiver(func)
+            if recv is not None:
+                self._check_cluster(node, recv, func.attr)
         if isinstance(func, ast.Attribute) and func.attr in LEDGER_METHODS:
             recv = _ledger_receiver(func)
             if recv is not None:
@@ -259,6 +283,22 @@ class _ObsVisitor(SymbolTracker):
                 "(docs/observability.md) and the provenance stream's "
                 "determinism fingerprint (docs/sim.md), so a "
                 "runtime-computed name breaks both",
+            )
+
+    def _check_cluster(self, node: ast.Call, recv: str, method: str) -> None:
+        name_arg: Optional[ast.AST] = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if name_arg is None or not _is_str_literal(name_arg):
+            self._emit(
+                "obs-cluster-static-name", node,
+                f"{recv}.{method}(...) queries/flags the cluster "
+                "observatory with a computed name; derived-series and "
+                "cluster flight-record names must be static string "
+                "literals — they feed the series catalog "
+                "(docs/observability.md) and the cluster-health "
+                "determinism fingerprint (docs/sim.md)",
             )
 
     def _check_flight(self, node: ast.Call, recv: str, method: str) -> None:

@@ -1,4 +1,5 @@
-"""babble-tpu command line: `run`, `keygen`, `version`
+"""babble-tpu command line: `run`, `keygen`, `sim`, `explain`, `status`,
+`lint`, `version`
 (reference: cmd/babble/main.go:11-15, cmd/babble/commands/run.go:28-155).
 
 Flags mirror the reference's run command; values may also come from an
@@ -194,6 +195,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="Self-test: run the N-seed bisector smoke "
                          "(seeded synthetic divergence must localize "
                          "exactly; clean pairs must localize nothing)")
+
+    st = sub.add_parser(
+        "status",
+        help="Cluster health dashboard: fleet frontier table, skew/"
+             "agreement series and partition suspicion from a live "
+             "node's GET /debug/cluster (docs/observability.md)",
+    )
+    st.add_argument("--addr", default="127.0.0.1:8000",
+                    help="HTTP service address of a running node")
+    st.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                    help="Re-render every SECS seconds until interrupted "
+                         "(0 = render once and exit)")
+    st.add_argument("--json", action="store_true",
+                    help="Print the raw /debug/cluster document instead "
+                         "of the rendered dashboard")
 
     # `lint` is dispatched before the main parse (main()): the analysis
     # runner owns its own argparse, and argparse.REMAINDER inside a
@@ -520,6 +536,102 @@ def explain_command(args: argparse.Namespace) -> int:
     return 0
 
 
+def render_status(doc: dict) -> str:
+    """Render one GET /debug/cluster document as a one-screen dashboard.
+    Pure (doc -> str), so the status smoke and tests exercise the exact
+    strings an operator sees."""
+    lines = []
+    addr = doc.get("addr") or "?"
+    derived = doc.get("derived") or {}
+    fleet = doc.get("fleet") or {}
+    susp = doc.get("suspicion") or {}
+    lines.append(
+        f"babble-tpu cluster status  (via {addr}, "
+        f"{len(fleet)} node{'s' if len(fleet) != 1 else ''})"
+    )
+    lines.append("")
+    hdr = (
+        f"{'node':<22} {'block':>6} {'round':>6} {'rung':<12} "
+        f"{'undec':>5} {'txs':>5} {'sigs':>5} {'ingr':>5} "
+        f"{'forks':>5} {'age':>7}"
+    )
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for a in sorted(fleet):
+        d = fleet[a]
+        mark = "*" if a == addr else " "
+        age = d.get("age")
+        lines.append(
+            f"{mark}{a:<21} {d.get('block', '?'):>6} "
+            f"{d.get('round', '?'):>6} {str(d.get('rung', '?')):<12} "
+            f"{d.get('undecided', '?'):>5} {d.get('txs', '?'):>5} "
+            f"{d.get('sigs', '?'):>5} {d.get('ingress', '?'):>5} "
+            f"{d.get('forks', '?'):>5} "
+            f"{('%.1fs' % age) if isinstance(age, (int, float)) else '?':>7}"
+        )
+    lines.append("")
+    skew = derived.get("babble_cluster_commit_skew_blocks", 0.0)
+    rskew = derived.get("babble_cluster_round_skew", 0.0)
+    agree = derived.get("babble_cluster_frontier_agreement", 1.0)
+    fame = derived.get("babble_cluster_fame_latency_rounds", 0.0)
+    lines.append(
+        f"commit skew: {skew:g} blocks   round skew: {rskew:g}   "
+        f"frontier agreement: {agree:g}   fame latency: {fame:g} rounds"
+    )
+    if agree < 1.0:
+        lines.append(
+            "!! FRONTIER DISAGREEMENT: a peer committed a different "
+            "block at a common index — investigate immediately"
+        )
+    if susp.get("suspected"):
+        lines.append(
+            f"!! PARTITION SUSPECTED: components "
+            f"{susp.get('components')}"
+        )
+    else:
+        lines.append("partition: none suspected")
+    return "\n".join(lines)
+
+
+def status_command(args: argparse.Namespace) -> int:
+    """`babble-tpu status` — fetch GET /debug/cluster from a live node
+    and render the cluster dashboard; `--watch SECS` re-renders in a
+    loop (docs/observability.md)."""
+    import time
+    import urllib.request
+
+    url = f"http://{args.addr}/debug/cluster"
+
+    def once() -> int:
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                doc = json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 — operator-facing fetch
+            print(f"status: cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(render_status(doc))
+        return 0
+
+    if args.watch <= 0:
+        return once()
+    try:
+        while True:
+            # clear-screen escape, like `watch`: the dashboard is a
+            # fixed-height single screen
+            sys.stdout.write("\x1b[2J\x1b[H")
+            rc = once()
+            sys.stdout.flush()
+            time.sleep(args.watch)  # det-ok: operator watch loop on a real terminal, never under the sim clock
+            if rc != 0:
+                # keep watching through transient fetch errors
+                continue
+    except KeyboardInterrupt:
+        return 0
+
+
 def keygen_command(args: argparse.Namespace) -> int:
     try:
         key = keygen(args.datadir)
@@ -547,6 +659,8 @@ def main(argv=None) -> int:
         return sim_command(args)
     if args.command == "explain":
         return explain_command(args)
+    if args.command == "status":
+        return status_command(args)
     if args.command == "keygen":
         return keygen_command(args)
     if args.command == "version":

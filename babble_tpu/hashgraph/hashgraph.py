@@ -108,6 +108,11 @@ class Hashgraph:
         # lets tests prove the adversarial branch was exercised
         self.coin_rounds = 0
         self.coin_flips = 0
+        # fork evidence observed locally: divergent re-derivations caught
+        # by check_block_immutable. Exported in the cluster HealthDigest
+        # (ISSUE 20) so any peer can see a neighbour that tripped the
+        # safety invariant even after it stopped committing.
+        self.fork_evidence = 0
         # deepest fame decision (j - round_index at the deciding vote):
         # 2 = every witness decided on the first ballot; >= 3 proves
         # contested fame (split votes forced extra voting rounds)
@@ -1773,6 +1778,7 @@ class Hashgraph:
         if not divergent and old.state_hash() and block.state_hash():
             divergent = old.state_hash() != block.state_hash()
         if divergent:
+            self.fork_evidence += 1
             msg = (
                 f"block {block.index()} body divergence: stored "
                 f"(round_received={old.round_received()}, "

@@ -40,6 +40,10 @@ class SyncResponse:
     # from_json only reads known keys) and the key is omitted when empty,
     # so untraced payloads stay byte-identical to the pre-trace wire
     traces: List[dict] = field(default_factory=list)
+    # OUT-OF-BAND cluster HealthDigests (ISSUE 20): same contract as
+    # Traces — never part of the signed event bytes, omitted when empty,
+    # ignored by digest-unaware nodes
+    cluster: List[dict] = field(default_factory=list)
 
     def to_json(self) -> dict:
         d = {
@@ -50,6 +54,8 @@ class SyncResponse:
         }
         if self.traces:
             d["Traces"] = self.traces
+        if self.cluster:
+            d["Cluster"] = self.cluster
         return d
 
     @classmethod
@@ -60,6 +66,7 @@ class SyncResponse:
             events=[WireEvent.from_json(e) for e in d.get("Events", [])],
             known={int(k): v for k, v in d.get("Known", {}).items()},
             traces=d.get("Traces") or [],
+            cluster=d.get("Cluster") or [],
         )
 
 
@@ -69,11 +76,15 @@ class EagerSyncRequest:
     events: List[WireEvent] = field(default_factory=list)
     # same out-of-band trace piggyback as SyncResponse (the push leg)
     traces: List[dict] = field(default_factory=list)
+    # same out-of-band HealthDigest piggyback as SyncResponse (ISSUE 20)
+    cluster: List[dict] = field(default_factory=list)
 
     def to_json(self) -> dict:
         d = {"FromID": self.from_id, "Events": [e.to_json() for e in self.events]}
         if self.traces:
             d["Traces"] = self.traces
+        if self.cluster:
+            d["Cluster"] = self.cluster
         return d
 
     @classmethod
@@ -82,6 +93,7 @@ class EagerSyncRequest:
             from_id=d["FromID"],
             events=[WireEvent.from_json(e) for e in d.get("Events", [])],
             traces=d.get("Traces") or [],
+            cluster=d.get("Cluster") or [],
         )
 
 

@@ -89,6 +89,15 @@ class Config:
     # babble_consensus_stalled gauge when round-received has not advanced
     # for this many Clock seconds despite pending work
     stall_deadline: float = 10.0
+    # cluster health plane (ISSUE 20, obs/clusterview.py): piggyback
+    # versioned HealthDigests on sync payloads (out-of-band, like
+    # tracing) and derive cluster series + partition suspicion from the
+    # federated fleet table. Flipping it changes no consensus behaviour.
+    cluster_health: bool = True
+    # Clock seconds without contact before a peer counts as stale for
+    # partition inference and before its digest stops feeding the
+    # derived series (at 3x this deadline)
+    cluster_staleness_deadline: float = 5.0
     # black-box flight recorder (obs/flightrec.py): bounded ring of typed
     # structured records dumped on stall/divergence/flap/SLO breach
     flightrec_capacity: int = 2048

@@ -15,6 +15,7 @@ from ..hashgraph import (
     Frame,
     Hashgraph,
     Store,
+    Trilean,
     WireEvent,
 )
 from ..peers import Peers
@@ -669,6 +670,99 @@ class Core:
 
     def get_last_block_index(self) -> int:
         return self.hg.store.last_block_index()
+
+    def get_block_hash_prefix(self, index: int, width: int = 18) -> str:
+        """Hex prefix of the committed block BODY hash at `index`, or ""
+        when the block is absent (never committed, or pruned past the
+        store window). Feeds the cluster frontier-agreement canary
+        (ISSUE 20). The body hash — not Block.hex() — is the consensus
+        identity: the full-block hash covers attached signatures and is
+        frozen at first call, so it legitimately differs across nodes
+        (and over time) for byte-identical committed bodies."""
+        if index < 0:
+            return ""
+        try:
+            block = self.hg.store.get_block(index)
+        except Exception:  # noqa: BLE001 — StoreErr or a rolled window
+            return ""
+        if not block.body.state_hash:
+            # mid-commit window: the hashgraph stores the block before the
+            # app commit lands its state hash in the body (node.commit
+            # mutates it in place). Hashing the pre-app body would publish
+            # a prefix that matches no final chain and read as a phantom
+            # fork — report "not comparable" until the hash is final.
+            return ""
+        return block.body.hash().hex()[:width]
+
+    def ladder_rung(self) -> str:
+        """Which engine rung the next consensus pass will take: "cpu"
+        (host backend), "live" (incremental device engine attached),
+        "mesh_queued" (async dispatch queue up), "cpu_fallback" (device
+        marked down), else "one_shot"/"mesh" by device count. Purely
+        observational — exported in the HealthDigest so operators can see
+        a fleet whose rungs diverged (one node demoted, rest live)."""
+        if self.consensus_backend == "cpu":
+            return "cpu"
+        if self._device_down:
+            return "cpu_fallback"
+        if getattr(self.hg, "_live_device_engine", None) is not None:
+            return "live"
+        if getattr(self.hg, "_mesh_dispatch_queue", None) is not None:
+            return "mesh_queued"
+        if self.mesh_devices and int(self.mesh_devices) > 1:
+            return "mesh"
+        return "one_shot"
+
+    def undecided_witnesses(self) -> Tuple[int, int]:
+        """(undecided-witness count, oldest-undecided age in rounds)
+        across the pending rounds — the fame-latency input of the cluster
+        HealthDigest. Age is measured against the store's last round so a
+        witness whose fame stalls while the graph advances reads as a
+        growing number."""
+        undecided = 0
+        oldest: Optional[int] = None
+        for pr in self.hg.pending_rounds:
+            if pr.decided:
+                continue
+            try:
+                ri = self.hg.store.get_round(pr.index)
+            except Exception:  # noqa: BLE001 — round rolled out of window
+                continue
+            n = sum(
+                1
+                for e in ri.events.values()
+                if e.witness and e.famous == Trilean.UNDEFINED
+            )
+            if n:
+                undecided += n
+                if oldest is None:
+                    oldest = pr.index
+        if oldest is None:
+            return 0, 0
+        try:
+            last = self.hg.store.last_round()
+        except Exception:  # noqa: BLE001
+            last = oldest
+        return undecided, max(0, int(last) - int(oldest))
+
+    def health_digest_body(self) -> Dict[str, object]:
+        """The consensus-owned fields of the node's HealthDigest
+        (ISSUE 20). The node layer adds identity, timestamps, ingress
+        backlog and the peer-staleness vector on top."""
+        block = self.get_last_block_index()
+        last_round = self.get_last_consensus_round_index()
+        undecided, oldest_age = self.undecided_witnesses()
+        return {
+            "block": int(block),
+            "bh": self.get_block_hash_prefix(block),
+            "round": int(last_round) if last_round is not None else -1,
+            "undecided": undecided,
+            "oldest_age": oldest_age,
+            "txs": len(self.transaction_pool),
+            "sigs": self.hg.pending_signatures(),
+            "rung": self.ladder_rung(),
+            "forks": int(getattr(self.hg, "fork_evidence", 0)),
+        }
 
     def need_gossip(self) -> bool:
         return (

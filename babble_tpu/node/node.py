@@ -276,6 +276,16 @@ class Node(NodeStateMachine):
         self.obs.gauge(
             "babble_last_block_index", "Last committed block index",
         ).set_function(lambda: self.core.get_last_block_index())
+        # commit frontier (ISSUE 20 satellite): the one source of truth
+        # the HealthDigest, /stats and the cluster observatory all read
+        self.obs.gauge(
+            "babble_commit_frontier_block",
+            "Committed block frontier (last block index; -1 before any)",
+        ).set_function(lambda: float(self.core.get_last_block_index()))
+        self.obs.gauge(
+            "babble_commit_frontier_round",
+            "Committed consensus round frontier (-1 before any)",
+        ).set_function(self._frontier_round)
         self.obs.gauge(
             "babble_consensus_events", "Events that reached consensus",
         ).set_function(lambda: self.core.get_consensus_events_count())
@@ -329,6 +339,20 @@ class Node(NodeStateMachine):
                 + self.ingress.pending()
             ),
         )
+
+        # cluster health plane (ISSUE 20): bind the local digest
+        # providers, then hand the observatory to the watchdog so a
+        # stall can classify itself as local lag vs cluster-wide stall
+        self.obs.clusterview.bind_local(
+            self.local_addr,
+            digest_fn=self._health_digest,
+            block_hash_fn=self.core.get_block_hash_prefix,
+            enabled=getattr(conf, "cluster_health", True),
+            staleness_deadline=getattr(
+                conf, "cluster_staleness_deadline", 5.0
+            ),
+        )
+        self.watchdog.clusterview = self.obs.clusterview
 
         self.obs.gauge(
             "babble_flightrec_records",
@@ -403,6 +427,24 @@ class Node(NodeStateMachine):
                 description="log-diameter cold-path section replay "
                             "(fast-sync / post-reset catch-up) stays under "
                             "the latency cap",
+            )
+            # cluster-scope objectives (ISSUE 20): evaluated from the
+            # local fleet table, so every node alarms on the same
+            # cluster-level anomaly without a central evaluator
+            self.slo.objective(
+                "cluster_commit_skew",
+                series="babble_cluster_commit_skew_blocks",
+                kind="below", threshold=20.0,
+                description="committed-block skew across live digests "
+                            "stays under 20 blocks",
+            )
+            self.slo.objective(
+                "cluster_frontier_agreement",
+                series="babble_cluster_frontier_agreement",
+                kind="above", threshold=0.5,
+                description="a majority of comparable peer digests agree "
+                            "with our chain at their frontier (safety "
+                            "canary)",
             )
 
         # rate limit for log_stats (satellite: no full dict per heartbeat)
@@ -515,6 +557,9 @@ class Node(NodeStateMachine):
             except queue.Empty:
                 continue
             self.watchdog.check()
+            # partition-suspicion edge detector + lag matrix refresh
+            # (cheap; reads the fleet table the gossip legs maintain)
+            self.obs.clusterview.check()
             if self.slo is not None:
                 self.slo.evaluate()
             # deadline pump: ship a partial ingress batch whose hold
@@ -629,6 +674,9 @@ class Node(NodeStateMachine):
                 # piggyback trace contexts for the traced txs the served
                 # diff carries (out-of-band: hash-safe by construction)
                 resp.traces = self.obs.traces.contexts_for(diff)
+                # piggyback the cluster fleet table (ISSUE 20): same
+                # out-of-band contract, omitted when empty
+                resp.cluster = self.obs.clusterview.wire_digests()
                 self._m_payload.labels(direction="served").observe(
                     len(resp.events)
                 )
@@ -650,6 +698,8 @@ class Node(NodeStateMachine):
         # _pull: the consensus hooks must find them)
         if cmd.traces:
             self.obs.traces.absorb(cmd.traces)
+        if cmd.cluster:
+            self.obs.clusterview.absorb(cmd.cluster)
         with self.core_lock:
             try:
                 self.sync(cmd.events)
@@ -748,17 +798,21 @@ class Node(NodeStateMachine):
                 return
             self._push(peer_addr, other_known)
         except Exception as e:
-            self._obs_sync(start, "error", peer_addr)
+            self._obs_sync(start, "error", peer_addr, err=e)
             if self._gossip_fail(peer_addr, e):
                 return_event.set()
             return
         self._obs_sync(start, "ok", peer_addr)
         self._gossip_ok(peer_addr)
 
-    def _obs_sync(self, start: float, result: str, peer_addr: str) -> None:
+    def _obs_sync(self, start: float, result: str, peer_addr: str,
+                  err: Optional[Exception] = None) -> None:
         """Record one outbound exchange into the sync histogram and the
         span ring (shared by the threaded path and the simulator's
-        event-driven exchanges in sim/cluster.py)."""
+        event-driven exchanges in sim/cluster.py). `err` carries the
+        failure for the observatory's silence-vs-refusal classifier;
+        the exchange START time backdates silence evidence so a long
+        transport timeout does not also delay partition detection."""
         now = self.clock.monotonic()
         self._m_sync.labels(result=result).observe(now - start)
         self.obs.tracer.record(
@@ -766,6 +820,9 @@ class Node(NodeStateMachine):
             {"peer": peer_addr, "result": result},
         )
         self.watchdog.note_sync(peer_addr, result == "ok")
+        self.obs.clusterview.note_contact(
+            peer_addr, result == "ok", t_start=start, err=err,
+        )
 
     def _gossip_fail(self, peer_addr: str, e: Exception) -> bool:
         """Bookkeeping for a failed exchange. Returns True when the failure
@@ -840,6 +897,8 @@ class Node(NodeStateMachine):
         # so the consensus hooks find them when the events land
         if resp.traces:
             self.obs.traces.absorb(resp.traces)
+        if resp.cluster:
+            self.obs.clusterview.absorb(resp.cluster)
         if resp.events:
             with self.core_lock:
                 self.sync(resp.events)
@@ -866,6 +925,7 @@ class Node(NodeStateMachine):
             EagerSyncRequest(
                 from_id=self.id, events=wire_events,
                 traces=self.obs.traces.contexts_for(diff),
+                cluster=self.obs.clusterview.wire_digests(),
             ),
         )
 
@@ -1148,6 +1208,37 @@ class Node(NodeStateMachine):
         )
         log("%s (consecutive bounces: %d)", msg, self._consecutive_bounces)
 
+    def _frontier_round(self) -> float:
+        """Committed consensus round frontier; -1 before any commit (the
+        gauge callback form of get_last_consensus_round_index)."""
+        r = self.core.get_last_consensus_round_index()
+        return float(r) if r is not None else -1.0
+
+    def _frontier_gauge(self, name: str) -> float:
+        """Read one frontier gauge back through the registry — /stats and
+        the HealthDigest deliberately consume the same series /metrics
+        exports instead of re-deriving it (ISSUE 20 satellite)."""
+        g = self.obs.registry.get(name)
+        return float(g.value()) if g is not None else -1.0
+
+    def _health_digest(self) -> Dict[str, object]:
+        """HealthDigest body (ISSUE 20): consensus fields from the core,
+        frontier indices read through the frontier gauges, plus the
+        node-owned ingress backlog. The observatory adds identity,
+        timestamp and the peer-staleness vector."""
+        d = self.core.health_digest_body()
+        block = int(self._frontier_gauge("babble_commit_frontier_block"))
+        if block != d["block"]:
+            # the frontier advanced between the core snapshot and the
+            # gauge read — recompute the prefix so bh always hashes the
+            # block the digest claims (else the agreement canary would
+            # see a phantom fork under concurrent commits)
+            d["bh"] = self.core.get_block_hash_prefix(block)
+        d["block"] = block
+        d["round"] = int(self._frontier_gauge("babble_commit_frontier_round"))
+        d["ingress"] = int(self.ingress.pending())
+        return d
+
     def get_stats(self) -> Dict[str, str]:
         elapsed = self.clock.monotonic() - self.start_time
         consensus_events = self.core.get_consensus_events_count()
@@ -1195,6 +1286,15 @@ class Node(NodeStateMachine):
             # ingress pipeline (ISSUE 16): txs held pre-pool (queued for a
             # token refill or coalescing in the open batch)
             "ingress_pending": str(self.ingress.pending()),
+            # commit frontier (ISSUE 20): read through the frontier
+            # gauges so /stats, the HealthDigest and the observatory
+            # report one source of truth
+            "commit_frontier_block": str(int(self._frontier_gauge(
+                "babble_commit_frontier_block"
+            ))),
+            "commit_frontier_round": str(int(self._frontier_gauge(
+                "babble_commit_frontier_round"
+            ))),
             **self._live_engine_stats(),
             **self._mesh_stats(),
             **self._table_bytes_stats(),

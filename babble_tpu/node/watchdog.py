@@ -77,6 +77,11 @@ class LivenessWatchdog:
         # decision — the record carries the stuck round's table
         # fingerprint so it can be diffed against a healthy peer's
         self.provenance = obs.provenance
+        # cluster observatory (ISSUE 20): bound by the node after both
+        # exist. When present, a stall classifies itself against the
+        # fleet table — peers committed past our frontier means WE lag;
+        # peers stuck at our frontier means the whole cluster stalled.
+        self.clusterview = None
         self._g_stalled = obs.gauge(
             "babble_consensus_stalled",
             "1 while round-received has not advanced within the stall "
@@ -127,6 +132,28 @@ class LivenessWatchdog:
                 ph.last_ok = now
             else:
                 ph.errors += 1
+
+    def _cluster_context(self):
+        """(cluster commit skew, [peer addrs committed past our
+        frontier]) from the observatory's fleet table; (0.0, []) when no
+        observatory is bound or it is disabled."""
+        cv = self.clusterview
+        if cv is None or not cv.enabled:
+            return 0.0, []
+        try:
+            fleet = cv.fleet()
+            skew = cv.series_value("babble_cluster_commit_skew_blocks")
+        except Exception:  # noqa: BLE001 — the watchdog must trip even
+            return 0.0, []  # when the observatory misbehaves
+        own = fleet.get(cv.addr, {})
+        own_block = own.get("block", -1)
+        ahead = sorted(
+            a for a, d in fleet.items()
+            if a != cv.addr
+            and isinstance(d.get("block"), int)
+            and d["block"] > own_block
+        )
+        return skew, ahead
 
     # ------------------------------------------------------------------
     # the periodic check
@@ -194,17 +221,37 @@ class LivenessWatchdog:
             # starts from the decision, not the whole ring
             stuck = (last_round + 1) if last_round is not None else 0
             prov_fp = self.provenance.round_fingerprint(stuck) or ""
+            # cluster context at trip time (ISSUE 20): the skew tells an
+            # operator instantly whether this is one node falling behind
+            # or the whole cluster frozen
+            cluster_skew, ahead_peers = self._cluster_context()
             self.flightrec.record(
                 "watchdog.stall", waited=waited, deadline=self.deadline,
                 round=last_round, last_decided_round=last_round,
                 stuck_round=stuck, prov=prov_fp,
+                cluster_skew=cluster_skew,
             )
+            if self.clusterview is not None and self.clusterview.enabled:
+                if ahead_peers:
+                    # healthy peers sit at a higher commit frontier: the
+                    # stall is local lag, not a cluster-wide freeze
+                    self.flightrec.record(
+                        "watchdog.local_lag", stuck_round=stuck,
+                        cluster_skew=cluster_skew,
+                        ahead_peers=len(ahead_peers),
+                    )
+                else:
+                    self.flightrec.record(
+                        "watchdog.cluster_stall", stuck_round=stuck,
+                        cluster_skew=cluster_skew,
+                    )
             # the black box exists for exactly this moment: dump the
             # ring (ladder/dispatch history preceding the stall) now
             self.flightrec.dump("consensus-stall", waited=waited,
                                 round=last_round,
                                 last_decided_round=last_round,
-                                stuck_round=stuck, prov=prov_fp)
+                                stuck_round=stuck, prov=prov_fp,
+                                cluster_skew=cluster_skew)
         elif recovered:
             self.logger.info(
                 "consensus resumed: round advanced to %s", rnd,

@@ -21,6 +21,13 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from ..common.clock import Clock, SYSTEM_CLOCK
+from .clusterview import (
+    ClusterObservatory,
+    DIGEST_VERSION,
+    HealthDigest,
+    MAX_FLEET,
+    failure_kind,
+)
 from .devledger import (
     DeviceLedger,
     ENTRY_INFO,
@@ -67,6 +74,11 @@ from .tracectx import (
 
 __all__ = [
     "Observability",
+    "ClusterObservatory",
+    "HealthDigest",
+    "DIGEST_VERSION",
+    "MAX_FLEET",
+    "failure_kind",
     "DeviceLedger",
     "ENTRY_INFO",
     "build_timeline",
@@ -143,6 +155,11 @@ class Observability:
         # ring behind GET /debug/timeline — durations follow the clock
         # policy (real SystemClock only; the sim records exact zeros)
         self.devledger = DeviceLedger(self)
+        # cluster health plane (ISSUE 20): federates piggybacked peer
+        # HealthDigests into derived cluster series, a queryable fleet
+        # table, and staleness-asymmetry partition inference; dormant
+        # until the node calls bind_local with its digest providers
+        self.clusterview = ClusterObservatory(self)
 
     # Delegates so call sites read `obs.counter("...")`. The name flows
     # through a parameter here, which the obs-dynamic-name rule cannot

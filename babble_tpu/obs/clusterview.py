@@ -1,0 +1,566 @@
+"""Cluster health plane (ISSUE 20): a federated consensus observatory.
+
+Every observability layer before this one — metrics (ISSUE 4), causal
+traces (ISSUE 5), the flight recorder + SLOs (ISSUE 7), decision
+provenance (ISSUE 14), the device-time ledger (ISSUE 19) — is strictly
+node-local, yet Babble's correctness and liveness properties are
+*cluster* properties: commit-frontier agreement, bounded round-advance
+skew, quorum reachability. The `ClusterObservatory` closes that gap:
+
+- each node assembles a compact, versioned `HealthDigest` (commit
+  frontier + block-hash prefix, round frontier, undecided-witness
+  count and oldest-undecided age, tx/ingress backlog, signature
+  backlog, engine-ladder rung, fork-evidence count, peer-staleness
+  vector) and piggybacks it **out-of-band** on sync payloads exactly
+  like the `Traces` key — wire hashes and signatures untouched, no new
+  RPCs; a pull fallback (`GET /health/digest`) covers non-gossiping
+  observers;
+- digests gossip transitively (a node forwards its whole fleet table),
+  so every node converges on an eventually-consistent fleet view;
+- from the fleet table the observatory derives the series node-local
+  metrics cannot express: `babble_cluster_commit_skew_blocks`,
+  `babble_cluster_round_skew`, `babble_cluster_frontier_agreement`
+  (a live safety canary — peers' block-hash prefixes checked against
+  our own chain at the common frontier), a per-peer lag matrix with
+  bounded labels, and `babble_cluster_fame_latency_rounds`;
+- **partition inference** from mutual-staleness asymmetry: sync
+  failures are classified by *kind* — a refusal (connection refused,
+  "peer down", "not ready") proves the path answers and is NOT
+  partition evidence; only *silence* (timeouts, dropped/partitioned
+  links) accumulates. A peer silent past the staleness deadline while
+  other peers stay fresh is the asymmetry signature of a partition
+  (a fully-isolated or crashed node sees every path fail and never
+  self-diagnoses a partition — by design, that is the watchdog's
+  job). Rising/falling edges emit `cluster.partition_suspected` /
+  `cluster.partition_healed` flight records with an automatic
+  flight-recorder dump, one record per episode.
+
+Determinism contract: everything times through the injected Clock, so
+under the sim the fleet table, derived series and suspicion components
+are byte-identical across same-seed runs —
+`SimCluster.result()["cluster_health"]` fingerprints them.
+
+Series and record names on observatory receivers must be static string
+literals — the `obs-cluster-static-name` analysis rule enforces it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "ClusterObservatory",
+    "HealthDigest",
+    "DIGEST_VERSION",
+    "MAX_FLEET",
+    "failure_kind",
+]
+
+# Digest schema version. Compat rule: entries missing the required keys
+# (v/addr/t/block) are dropped; any v >= 1 entry is accepted field-wise
+# with unknown keys carried opaquely (newer nodes may add fields old
+# nodes forward untouched); per-origin merge is newest-t-wins.
+DIGEST_VERSION = 1
+
+# Fleet-table bound: beyond this many distinct origins, unknown origins
+# are dropped (matches MAX_LABEL_SETS so the lag matrix never overflows
+# into the collapsed `other` series before the table itself saturates).
+MAX_FLEET = 64
+
+# A digest older than stale_factor * staleness deadline is excluded from
+# the derived series (a crashed peer's last digest must not pin the
+# cluster skew forever) but stays in the fleet table, age-annotated.
+STALE_DIGEST_FACTOR = 3.0
+
+# Consecutive silent failures required before a peer counts as silent —
+# a single dropped packet on a lossy (non-partitioned) link must not
+# trip suspicion (false-positive guard).
+MIN_SILENT_FAILS = 2
+
+# Substrings that mark a sync failure as *silence* (no answer from the
+# far side) rather than *refusal* (the path answered with an error).
+# Sim transport reasons: "partitioned: a -/- b", "dropped: a -> b";
+# real TCP: "timed out" / "timeout". Everything else — connection
+# refused, "peer down", "node down", "not ready", app-level errors —
+# proves reachability and therefore clears silence.
+_SILENCE_MARKERS = ("partitioned", "dropped", "timed out", "timeout")
+
+HealthDigest = Dict[str, Any]
+
+
+def failure_kind(err: Any) -> str:
+    """Classify a sync failure as "silence" or "refusal" (see module
+    docstring). The classification keys off the error text because the
+    transports funnel every failure through one exception type."""
+    msg = str(err).lower()
+    if any(marker in msg for marker in _SILENCE_MARKERS):
+        return "silence"
+    return "refusal"
+
+
+class _Contact:
+    """Per-peer reachability ledger feeding partition inference."""
+
+    __slots__ = ("last_ok", "silent_since", "silent_fails")
+
+    def __init__(self) -> None:
+        self.last_ok: Optional[float] = None
+        self.silent_since: Optional[float] = None
+        self.silent_fails: int = 0
+
+
+class ClusterObservatory:
+    """Federates per-node `HealthDigest`s into derived cluster series,
+    a queryable fleet table, and partition suspicion. One per node,
+    constructed by `Observability`; dormant until `bind_local`."""
+
+    def __init__(self, obs) -> None:
+        self.obs = obs
+        self.clock = obs.clock
+        self.flightrec = obs.flightrec
+        self.enabled = False  # unguarded-ok: bool flag set once at bind_local; racy fast-path reads are benign
+        self.addr: Optional[str] = None  # unguarded-ok: set once at bind_local before gossip starts; str reads are atomic
+        self.staleness_deadline = 5.0  # guarded-by: _lock
+        self._digest_fn: Optional[Callable[[], Dict[str, Any]]] = None  # guarded-by: _lock
+        self._block_hash_fn: Optional[Callable[[int], str]] = None  # guarded-by: _lock
+        self._lock = threading.RLock()
+        self._fleet: Dict[str, HealthDigest] = {}  # guarded-by: _lock
+        # local receive time per origin: digest liveness is judged by
+        # when WE last heard a fresh digest, not by the origin's own
+        # timestamp — peers' monotonic epochs are not comparable across
+        # real processes (they are in the sim, but the sim must not be
+        # the only place staleness works)
+        self._seen: Dict[str, float] = {}  # guarded-by: _lock
+        self._contacts: Dict[str, _Contact] = {}  # guarded-by: _lock
+        self._suspected = False  # guarded-by: _lock
+        self._components: List[List[str]] = []  # guarded-by: _lock
+        self._suspect_since: Optional[float] = None  # guarded-by: _lock
+
+        reg = obs.registry
+        reg.gauge(
+            "babble_cluster_size",
+            "Distinct nodes in the local fleet table (self included)",
+        ).set_function(lambda: float(len(self.fleet())))
+        reg.gauge(
+            "babble_cluster_commit_skew_blocks",
+            "Max minus min committed block index across live digests",
+        ).set_function(lambda: self.series_value("babble_cluster_commit_skew_blocks"))
+        reg.gauge(
+            "babble_cluster_round_skew",
+            "Max minus min consensus round across live digests",
+        ).set_function(lambda: self.series_value("babble_cluster_round_skew"))
+        reg.gauge(
+            "babble_cluster_frontier_agreement",
+            "Fraction of comparable digests whose block-hash prefix "
+            "matches our chain at their frontier (safety canary)",
+        ).set_function(lambda: self.series_value("babble_cluster_frontier_agreement"))
+        reg.gauge(
+            "babble_cluster_fame_latency_rounds",
+            "Oldest undecided-witness age, in rounds, across the fleet",
+        ).set_function(lambda: self.series_value("babble_cluster_fame_latency_rounds"))
+        reg.gauge(
+            "babble_cluster_partition_suspected",
+            "1 while a partition is suspected from staleness asymmetry",
+        ).set_function(lambda: self.series_value("babble_cluster_partition_suspected"))
+        # per-peer lag matrix: written (not set_function) inside check()
+        # because labelled series have no pull-time callback form
+        self._lag_gauge = reg.gauge(
+            "babble_cluster_peer_lag_blocks",
+            "Our committed block index minus the peer's (positive: peer "
+            "lags us; negative: peer is ahead)",
+            labels=("peer",),
+        )
+
+    # -- wiring ------------------------------------------------------------
+
+    def bind_local(
+        self,
+        addr: str,
+        digest_fn: Callable[[], Dict[str, Any]],
+        block_hash_fn: Optional[Callable[[int], str]] = None,
+        enabled: bool = True,
+        staleness_deadline: float = 5.0,
+    ) -> None:
+        """Attach the node-side providers: `digest_fn` returns the digest
+        body (block/bh/round/undecided/...), `block_hash_fn(index)` our
+        own block-hash prefix at an index (for frontier agreement)."""
+        with self._lock:
+            self.addr = addr
+            self._digest_fn = digest_fn
+            self._block_hash_fn = block_hash_fn
+            self.enabled = bool(enabled)
+            self.staleness_deadline = float(staleness_deadline)
+
+    # -- digest assembly / federation --------------------------------------
+
+    def local_digest(self) -> HealthDigest:
+        """Our own versioned digest, freshly assembled. Empty dict until
+        bind_local (bare Observability in unit tests)."""
+        with self._lock:
+            if self.addr is None or self._digest_fn is None:
+                return {}
+            d: HealthDigest = {
+                "v": DIGEST_VERSION,
+                "id": self.obs.node_id,
+                "addr": self.addr,
+                "t": round(float(self.clock.monotonic()), 9),
+            }
+            try:
+                d.update(self._digest_fn() or {})
+            except Exception:  # noqa: BLE001 — a broken provider must not
+                pass  # take gossip down; the digest just stays sparse
+            now = self.clock.monotonic()
+            d["stale"] = {
+                peer: round(float(now - c.last_ok), 9)
+                for peer, c in sorted(self._contacts.items())
+                if c.last_ok is not None
+            }
+            return d
+
+    def wire_digests(self) -> List[HealthDigest]:
+        """The out-of-band payload for a sync response/push: our own
+        fresh digest plus every absorbed peer digest (transitive gossip).
+        Empty when disabled, so the wire key is omitted and payloads stay
+        byte-identical to an undigested build."""
+        if not self.enabled:
+            return []
+        own = self.local_digest()
+        if not own:
+            return []
+        with self._lock:
+            self._store_own(own)
+            return [self._fleet[a] for a in sorted(self._fleet)]
+
+    def _store_own(self, own: HealthDigest) -> None:  # requires-lock: _lock
+        self._fleet[self.addr] = own  # type: ignore[index]
+        self._seen[self.addr] = float(self.clock.monotonic())  # type: ignore[index]
+
+    def absorb(self, entries: Optional[Sequence[HealthDigest]]) -> None:
+        """Merge piggybacked digests into the fleet table: validated,
+        newest-t-wins per origin, own addr never absorbed, MAX_FLEET
+        bound (known origins update; novel ones drop when full)."""
+        if not self.enabled or not entries:
+            return
+        with self._lock:
+            for e in entries:
+                if not isinstance(e, dict):
+                    continue
+                addr = e.get("addr")
+                if (
+                    not isinstance(e.get("v"), int)
+                    or e["v"] < 1
+                    or not isinstance(addr, str)
+                    or not isinstance(e.get("t"), (int, float))
+                    or not isinstance(e.get("block"), int)
+                ):
+                    continue  # compat rule: required keys or drop
+                if addr == self.addr:
+                    continue
+                now = float(self.clock.monotonic())
+                prev = self._fleet.get(addr)
+                if prev is not None and prev.get("t", 0) >= e["t"]:
+                    # newest-t wins within one origin incarnation — but a
+                    # restarted origin's monotonic clock regressed, so an
+                    # entry we have not refreshed for a full staleness
+                    # horizon loses to ANY fresh digest
+                    horizon = STALE_DIGEST_FACTOR * self.staleness_deadline
+                    if now - self._seen.get(addr, now) <= horizon:
+                        continue
+                if prev is None and len(self._fleet) >= MAX_FLEET:
+                    continue  # bounded table
+                self._fleet[addr] = e
+                self._seen[addr] = now
+
+    # -- contact ledger (partition-inference input) ------------------------
+
+    def note_contact(
+        self,
+        peer: str,
+        ok: bool,
+        t_start: Optional[float] = None,
+        err: Any = None,
+    ) -> None:
+        """Record one sync exchange outcome with `peer`. `t_start` is the
+        exchange *start* time: silence is backdated to it, so a long
+        transport timeout does not also delay partition detection."""
+        if not self.enabled or not peer:
+            return
+        with self._lock:
+            c = self._contacts.setdefault(peer, _Contact())
+            if ok:
+                c.last_ok = float(self.clock.monotonic())
+                c.silent_since = None
+                c.silent_fails = 0
+            elif failure_kind(err) == "silence":
+                if c.silent_since is None:
+                    c.silent_since = float(
+                        t_start if t_start is not None else self.clock.monotonic()
+                    )
+                c.silent_fails += 1
+            else:
+                # a refusal proves the path answers: not partition evidence
+                c.silent_since = None
+                c.silent_fails = 0
+
+    # -- suspicion state machine -------------------------------------------
+
+    def check(self) -> None:
+        """Heartbeat hook: refresh the lag matrix and run the partition
+        suspicion edge detector. Cheap; call once per node tick."""
+        if not self.enabled:
+            return
+        with self._lock:
+            now = float(self.clock.monotonic())
+            deadline = self.staleness_deadline
+            own = self.local_digest()
+            if own:
+                self._store_own(own)
+                own_block = int(own.get("block", -1))
+                for addr in sorted(self._fleet):
+                    if addr == self.addr:
+                        continue
+                    peer_block = self._fleet[addr].get("block")
+                    if isinstance(peer_block, int):
+                        self._lag_gauge.labels(peer=addr).set(
+                            float(own_block - peer_block)
+                        )
+            # a peer is partition-silent only when BOTH channels died:
+            # direct contact (>= MIN_SILENT_FAILS consecutive silent
+            # failures spanning the deadline) AND its federated digest
+            # (no fresh digest via ANY path within the deadline). On a
+            # merely lossy link the peer's digest keeps arriving
+            # relayed through third parties, so loss never qualifies —
+            # only a true cut starves both channels.
+            silent = sorted(
+                p
+                for p, c in self._contacts.items()
+                if c.silent_since is not None
+                and now - c.silent_since >= deadline
+                and c.silent_fails >= MIN_SILENT_FAILS
+                and (
+                    p not in self._seen
+                    or now - self._seen[p] >= deadline
+                )
+            )
+            # fresh counter-evidence must POSTDATE the silence: a
+            # last_ok from just before a full cut would otherwise let
+            # the isolated minority itself claim the asymmetry
+            silence_start = min(
+                (
+                    self._contacts[p].silent_since
+                    for p in silent
+                    if self._contacts[p].silent_since is not None
+                ),
+                default=None,
+            )
+            fresh = sorted(
+                p
+                for p, c in self._contacts.items()
+                if c.last_ok is not None
+                and now - c.last_ok <= deadline
+                and (silence_start is None or c.last_ok >= silence_start)
+            )
+            suspected = bool(silent) and bool(fresh)
+            if suspected and not self._suspected:
+                self._suspected = True
+                self._suspect_since = now
+                # near side = everyone known to the fleet table who is
+                # not silent (self included): fresh contacts alone would
+                # omit reachable peers we simply have not gossiped with
+                # recently, under-reporting the majority component
+                near = sorted(
+                    (set([self.addr or ""]) | set(self._fleet) | set(fresh))
+                    - set(silent)
+                )
+                self._components = sorted(
+                    [silent, near], key=lambda c: c[0] if c else ""
+                )
+                self.flightrec.record(
+                    "cluster.partition_suspected",
+                    components=json.dumps(self._components),
+                    silent=len(silent),
+                    fresh=len(fresh),
+                )
+                self.flightrec.dump(
+                    "partition-suspected",
+                    components=json.dumps(self._components),
+                )
+            elif self._suspected and not silent:
+                # falling edge: every silent peer answered again (or its
+                # silence was reclassified by a refusal)
+                since = self._suspect_since if self._suspect_since is not None else now
+                self._suspected = False
+                self._suspect_since = None
+                self._components = []
+                self.flightrec.record(
+                    "cluster.partition_healed",
+                    duration=round(now - since, 9),
+                )
+
+    # -- derived series / queries ------------------------------------------
+
+    def fleet(self) -> Dict[str, HealthDigest]:
+        """Copy of the fleet table (own fresh digest included)."""
+        with self._lock:
+            own = self.local_digest()
+            if own:
+                self._store_own(own)
+            return {a: dict(self._fleet[a]) for a in sorted(self._fleet)}
+
+    def _live_digests(self) -> List[HealthDigest]:  # requires-lock: _lock
+        now = float(self.clock.monotonic())
+        horizon = STALE_DIGEST_FACTOR * self.staleness_deadline
+        return [
+            d
+            for a, d in sorted(self._fleet.items())
+            if now - self._seen.get(a, now) <= horizon
+        ]
+
+    def derived(self) -> Dict[str, float]:
+        """All derived cluster series, from live digests only."""
+        with self._lock:
+            own = self.local_digest()
+            if own:
+                self._store_own(own)
+            live = self._live_digests()
+            blocks = [int(d["block"]) for d in live if isinstance(d.get("block"), int)]
+            rounds = [
+                int(d["round"])
+                for d in live
+                if isinstance(d.get("round"), int) and d["round"] >= 0
+            ]
+            ages = [
+                int(d["oldest_age"])
+                for d in live
+                if isinstance(d.get("oldest_age"), int)
+            ]
+            agreement = self._frontier_agreement(own, live)
+            return {
+                "babble_cluster_size": float(len(live)),
+                "babble_cluster_commit_skew_blocks": float(
+                    max(blocks) - min(blocks) if blocks else 0
+                ),
+                "babble_cluster_round_skew": float(
+                    max(rounds) - min(rounds) if rounds else 0
+                ),
+                "babble_cluster_frontier_agreement": agreement,
+                "babble_cluster_fame_latency_rounds": float(
+                    max(ages) if ages else 0
+                ),
+                "babble_cluster_partition_suspected": float(self._suspected),
+            }
+
+    def _frontier_agreement(  # requires-lock: _lock
+        self, own: HealthDigest, live: List[HealthDigest]
+    ) -> float:
+        """Safety canary: of the live digests whose frontier we can check
+        (their committed index <= ours), what fraction carry a block-hash
+        prefix matching our own chain at that index? Self always agrees;
+        1.0 when nothing is comparable. Any value below 1.0 on a healthy
+        cluster means two nodes committed different blocks at the same
+        index — the one anomaly that must never be smoothed over."""
+        own_block = int(own.get("block", -1)) if own else -1
+        comparable, agree = 1, 1  # self
+        if self._block_hash_fn is None:
+            return 1.0
+        for d in live:
+            addr = d.get("addr")
+            if addr == self.addr:
+                continue
+            peer_block = d.get("block")
+            peer_prefix = d.get("bh")
+            if (
+                not isinstance(peer_block, int)
+                or peer_block < 0
+                or peer_block > own_block
+                or not isinstance(peer_prefix, str)
+                or not peer_prefix
+            ):
+                continue
+            try:
+                mine = self._block_hash_fn(peer_block) or ""
+            except Exception:  # noqa: BLE001 — pruned store window
+                continue
+            if not mine:
+                continue
+            comparable += 1
+            n = min(len(mine), len(peer_prefix))
+            if mine[:n] == peer_prefix[:n]:
+                agree += 1
+        return round(agree / comparable, 9)
+
+    def series_value(self, name: str) -> float:
+        """One derived series by its exported name (static literals only —
+        enforced by the obs-cluster-static-name rule at call sites)."""
+        return float(self.derived().get(name, 0.0))
+
+    def flag(self, name: str, **fields: Any) -> None:
+        """Emit a cluster-scope flight record (static literal names only —
+        enforced by the obs-cluster-static-name rule at call sites)."""
+        self.flightrec.record(name, **fields)  # obs-ok: delegate, name checked at call sites
+
+    def suspicion(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "suspected": self._suspected,
+                "components": [list(c) for c in self._components],
+                "since": (
+                    round(float(self._suspect_since), 9)
+                    if self._suspect_since is not None
+                    else None
+                ),
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full health plane, as served by `GET /debug/cluster` and
+        rendered by `babble-tpu status`."""
+        with self._lock:
+            fleet = self.fleet()
+            # `now` read after fleet() so the just-refreshed own digest
+            # cannot show a negative age
+            now = float(self.clock.monotonic())
+            for a, d in fleet.items():
+                d["age"] = round(max(0.0, now - self._seen.get(a, now)), 9)
+            contacts = {
+                p: {
+                    "last_ok_age": (
+                        round(now - c.last_ok, 9) if c.last_ok is not None else None
+                    ),
+                    "silent_for": (
+                        round(now - c.silent_since, 9)
+                        if c.silent_since is not None
+                        else None
+                    ),
+                    "silent_fails": c.silent_fails,
+                }
+                for p, c in sorted(self._contacts.items())
+            }
+            return {
+                "addr": self.addr,
+                "enabled": self.enabled,
+                "t": round(now, 9),
+                "staleness_deadline": self.staleness_deadline,
+                "fleet": fleet,
+                "derived": self.derived(),
+                "suspicion": self.suspicion(),
+                "contacts": contacts,
+            }
+
+    # -- determinism fingerprint -------------------------------------------
+
+    def health_doc(self) -> Dict[str, Any]:
+        """The deterministic slice of the health plane: derived series
+        plus suspicion, floats pre-rounded — the sim's
+        `cluster_health_fingerprint` hashes the canonical JSON of one of
+        these per node."""
+        derived = {k: round(v, 9) for k, v in sorted(self.derived().items())}
+        return {"derived": derived, "suspicion": self.suspicion()}
+
+    def stream_bytes(self) -> bytes:
+        """Canonical JSON bytes of `health_doc` (sorted keys, compact
+        separators — same convention as FlightRecorder.stream_bytes)."""
+        return json.dumps(
+            self.health_doc(), sort_keys=True, separators=(",", ":")
+        ).encode()

@@ -245,6 +245,17 @@ class Service:
                             return
                         body = obs.registry.expose().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path == "/health/digest":
+                        # pull fallback for the cluster health plane
+                        # (ISSUE 20): a non-gossiping observer fetches the
+                        # node's own HealthDigest. Ungated like /stats —
+                        # it is a compact health summary, not a debug dump
+                        obs = getattr(service.node, "obs", None)
+                        cv = getattr(obs, "clusterview", None)
+                        if cv is None:
+                            self.send_error(404, "node has no observatory")
+                            return
+                        body = json.dumps(cv.local_digest()).encode()
                     elif self.path.startswith("/block/"):
                         index = int(self.path[len("/block/"):])
                         body = json.dumps(
@@ -311,6 +322,18 @@ class Service:
                                 block=int(blk) if blk is not None else None,
                                 round=int(rnd) if rnd is not None else None,
                             )).encode()
+                        elif self.path == "/debug/cluster":
+                            # full health plane: fleet table + derived
+                            # series + suspicion (ISSUE 20); what the
+                            # `babble-tpu status` renderer consumes
+                            obs = getattr(service.node, "obs", None)
+                            cv = getattr(obs, "clusterview", None)
+                            if cv is None:
+                                self.send_error(
+                                    404, "node has no observatory"
+                                )
+                                return
+                            body = json.dumps(cv.snapshot()).encode()
                         elif self.path == "/debug/slo":
                             slo = getattr(service.node, "slo", None)
                             if slo is None:

@@ -120,6 +120,10 @@ class SimCluster:
         logger: Optional[logging.Logger] = None,
         tracing: bool = True,
         stall_deadline: float = 10.0,
+        cluster_health: bool = True,
+        # staleness deadline scaled to sim time: heartbeats run at 50ms,
+        # so 1.5 virtual seconds of silence is ~30 missed exchanges
+        cluster_staleness: float = 1.5,
     ):
         if store not in ("inmem", "sqlite"):
             raise ValueError("store must be 'inmem' or 'sqlite'")
@@ -159,6 +163,8 @@ class SimCluster:
         self.inject_interval = inject_interval
         self.tracing = tracing
         self.stall_deadline = stall_deadline
+        self.cluster_health = cluster_health
+        self.cluster_staleness = cluster_staleness
 
         self.clock = SimClock()
         self.sched = SimScheduler(self.clock)
@@ -226,6 +232,8 @@ class SimCluster:
             logger=self.logger,
             tracing=self.tracing,
             stall_deadline=self.stall_deadline,
+            cluster_health=self.cluster_health,
+            cluster_staleness_deadline=self.cluster_staleness,
         )
         if self.store_kind == "sqlite":
             node_store = SQLiteStore(
@@ -306,6 +314,9 @@ class SimCluster:
         # detection and burn-rate evaluation are part of the
         # deterministic replay (gauge values ride virtual time)
         node.watchdog.check()
+        # partition-suspicion edge detector + lag matrix, exactly like
+        # the threaded _babble tick (cluster records ride virtual time)
+        node.obs.clusterview.check()
         if node.slo is not None:
             node.slo.evaluate()
         # deadline pump for the ingress pipeline, exactly like the
@@ -353,7 +364,7 @@ class SimCluster:
             if sn.gen != gen or sn.crashed:
                 return
             sn.exchange_inflight = False
-            node._obs_sync(ex_start, "error", peer_addr)
+            node._obs_sync(ex_start, "error", peer_addr, err=e)
             if node._gossip_fail(peer_addr, e):
                 sn.catchup_flips += 1
                 self._trace(f"{sn.name} -> CatchingUp (livelock escape)")
@@ -376,6 +387,8 @@ class SimCluster:
                 # exactly like the threaded _pull
                 if resp.traces:
                     node.obs.traces.absorb(resp.traces)
+                if resp.cluster:
+                    node.obs.clusterview.absorb(resp.cluster)
                 if resp.events:
                     with node.core_lock:
                         node.sync(resp.events)
@@ -402,6 +415,7 @@ class SimCluster:
                 EagerSyncRequest(
                     from_id=node.id, events=wire_events,
                     traces=node.obs.traces.contexts_for(diff),
+                    cluster=node.obs.clusterview.wire_digests(),
                 ),
                 on_ok=on_push_ok, on_fail=finish_fail,
                 label=f"{sn.name}:push",
@@ -662,6 +676,8 @@ class SimCluster:
             "ingress": self.ingress_counters(),
             "trace_fingerprint": self.trace_fingerprint(),
             "flightrec_fingerprint": self.flightrec_fingerprint(),
+            "cluster_health": self.cluster_health_doc(),
+            "cluster_health_fingerprint": self.cluster_health_fingerprint(),
             "provenance_fingerprint": self.provenance_fingerprint(),
             "ledger_fingerprint": self.ledger_fingerprint(),
             "flightrec_records": {
@@ -786,6 +802,57 @@ class SimCluster:
                 continue
             h.update(sn.name.encode())
             h.update(sn.node.obs.flightrec.stream_bytes())
+        return h.hexdigest()
+
+    def cluster_health_doc(self) -> Dict[str, Any]:
+        """Per-live-node derived cluster series + partition suspicion
+        (the deterministic slice of each observatory's health plane),
+        plus a cluster summary row for sweep tables: max commit skew,
+        min frontier agreement, partitions suspected anywhere, and the
+        union of suspected components. All floats pre-rounded — part of
+        the determinism contract (docs/sim.md)."""
+        nodes: Dict[str, Any] = {}
+        max_skew = 0.0
+        min_agreement = 1.0
+        suspected = 0
+        components: List[List[str]] = []
+        for sn in self.sns:
+            # disabled observatories report the plane as absent, not as
+            # a table of zeroes (the cluster_health=False differential)
+            if sn.crashed or not sn.node.obs.clusterview.enabled:
+                continue
+            doc = sn.node.obs.clusterview.health_doc()
+            nodes[sn.name] = doc
+            d = doc["derived"]
+            max_skew = max(max_skew, d["babble_cluster_commit_skew_blocks"])
+            min_agreement = min(
+                min_agreement, d["babble_cluster_frontier_agreement"]
+            )
+            if doc["suspicion"]["suspected"]:
+                suspected += 1
+                for comp in doc["suspicion"]["components"]:
+                    if comp not in components:
+                        components.append(comp)
+        return {
+            "nodes": nodes,
+            "summary": {
+                "max_commit_skew_blocks": max_skew,
+                "min_frontier_agreement": min_agreement,
+                "partitions_suspected": suspected,
+                "suspected_components": sorted(components),
+            },
+        }
+
+    def cluster_health_fingerprint(self) -> str:
+        """SHA-256 over every live node's canonical health-plane bytes,
+        in node order — the cluster observatory's entry in the
+        determinism fingerprint (ISSUE 20)."""
+        h = sha256()
+        for sn in self.sns:
+            if sn.crashed or not sn.node.obs.clusterview.enabled:
+                continue
+            h.update(sn.name.encode())
+            h.update(sn.node.obs.clusterview.stream_bytes())
         return h.hexdigest()
 
     def ledger_fingerprint(self) -> str:

@@ -54,6 +54,8 @@ def run_one(
     heartbeat: float = 0.05,
     tracing: bool = True,
     stall_deadline: float = 10.0,
+    cluster_health: bool = True,
+    cluster_staleness: float = 1.5,
 ) -> Dict[str, Any]:
     """One seeded run. Returns the cluster's result dict plus `ok` /
     `error` / `artifact` fields; never raises on divergence."""
@@ -88,6 +90,8 @@ def run_one(
         heartbeat=heartbeat,
         tracing=tracing,
         stall_deadline=stall_deadline,
+        cluster_health=cluster_health,
+        cluster_staleness=cluster_staleness,
     )
     cert_before = 0
     if cert is not None:
@@ -198,5 +202,38 @@ def run_sweep(
             if r.get("bisect_artifact")
         ],
         "total_blocks_checked": sum(r["blocks_checked"] for r in rows),
+        # cluster-health row (ISSUE 20): the certification harness gates
+        # on skew/agreement/partition counts, not just commit digests
+        "cluster_health": _aggregate_cluster_health(rows),
         "rows": rows,
+    }
+
+
+def _aggregate_cluster_health(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Worst-case cluster-health summary across a sweep's rows: max
+    commit skew, min frontier agreement, total partition suspicions and
+    the union of suspected components (rows predating the health plane
+    contribute nothing)."""
+    max_skew = 0.0
+    min_agreement = 1.0
+    suspected = 0
+    components: List[List[str]] = []
+    for r in rows:
+        ch = r.get("cluster_health")
+        if not isinstance(ch, dict):
+            continue
+        s = ch.get("summary", {})
+        max_skew = max(max_skew, float(s.get("max_commit_skew_blocks", 0.0)))
+        min_agreement = min(
+            min_agreement, float(s.get("min_frontier_agreement", 1.0))
+        )
+        suspected += int(s.get("partitions_suspected", 0))
+        for comp in s.get("suspected_components", []):
+            if comp not in components:
+                components.append(comp)
+    return {
+        "max_commit_skew_blocks": max_skew,
+        "min_frontier_agreement": min_agreement,
+        "partitions_suspected": suspected,
+        "suspected_components": sorted(components),
     }

@@ -244,6 +244,9 @@ def main(argv=None):
         "retries_offered": gen.retries,
         "digest_match": digest_match,
         "virtual_time": vtime,
+        # cluster health plane (ISSUE 20): worst-case skew/agreement and
+        # partition suspicions over the run, for the bench_trend gate
+        "cluster_health": (res.get("cluster_health") or {}).get("summary"),
         "metrics": obs.registry.snapshot(),
     }
     print(json.dumps(headline))

@@ -552,6 +552,21 @@ def main(argv=None):
         "(budget: zero)",
     ).set(float(sum(retraces.values())))
 
+    # cluster health plane (ISSUE 20): a short seeded SimCluster run on
+    # the device backend — the health summary (worst skew, frontier
+    # agreement, partition suspicions) rides in the headline so
+    # bench_trend gates cluster convergence alongside kernel throughput
+    from babble_tpu.sim import SimCluster
+
+    probe = SimCluster(n=4, seed=0, backend="tpu", heartbeat=0.05)
+    try:
+        probe_res = probe.run(until=30.0, target_block=5)
+        cluster_health = (probe_res.get("cluster_health") or {}).get(
+            "summary"
+        )
+    finally:
+        probe.shutdown()
+
     top = per_n[str(sweep[-1])]
     headline_rpd = (
         anchor["rounds_per_dispatch"] if anchor
@@ -580,6 +595,7 @@ def main(argv=None):
                     top["packed"]["table_bytes_reduction"]
                 ),
                 "catchup_anchor": anchor,
+                "cluster_health": cluster_health,
                 "validators": per_n,
                 "metrics": obs.registry.snapshot(),
             }

@@ -17,6 +17,10 @@ Artifact shapes handled (oldest rounds predate the structured headline):
 - BENCH_INGEST_r*.json: same shape as BENCH; gated twice — committed
   tx/s (higher is better) and submit->commit p99 seconds (lower is
   better), both read from the bench_ingest.py headline.
+- BENCH_INGEST/BENCH_MESH headlines additionally carry a
+  "cluster_health" summary (ISSUE 20); its max_commit_skew_blocks is
+  gated lower-is-better so a fabric that converges with growing
+  frontier skew counts as a regression even when throughput holds.
 Rounds with rc != 0 or no extractable number are reported and skipped.
 """
 
@@ -103,6 +107,26 @@ def ingest_p99_value(doc):
     if headline and isinstance(headline.get("p99_s"), (int, float)):
         return float(headline["p99_s"])
     return None
+
+
+def cluster_skew_value(doc):
+    """Worst-case cluster commit skew (blocks) of one round's headline
+    `cluster_health` summary (ISSUE 20), or None for rounds predating
+    the health plane. Gated lower-is-better: a bench round whose fabric
+    converged with growing frontier skew regressed even if throughput
+    held."""
+    if doc.get("rc") != 0:
+        return None
+    headline = _last_json_line(doc.get("tail"))
+    if headline is None:
+        headline = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else None
+    if not headline:
+        return None
+    ch = headline.get("cluster_health")
+    if not isinstance(ch, dict):
+        return None
+    skew = ch.get("max_commit_skew_blocks")
+    return float(skew) if isinstance(skew, (int, float)) else None
 
 
 def load_series(pattern, extract):
@@ -233,6 +257,16 @@ def main():
         (
             "ingest submit->commit p99", "BENCH_INGEST_r*.json",
             ingest_p99_value, "s", min,
+        ),
+        # cluster health plane (ISSUE 20): the benches' worst-case
+        # commit-frontier skew must not trend upward round-over-round
+        (
+            "ingest cluster commit skew", "BENCH_INGEST_r*.json",
+            cluster_skew_value, "blocks", min,
+        ),
+        (
+            "mesh cluster commit skew", "BENCH_MESH_r*.json",
+            cluster_skew_value, "blocks", min,
         ),
     )
     failed = [

@@ -55,6 +55,14 @@ JAX_PLATFORMS=cpu python bench_ingest.py --smoke --slo || rc=1
 echo "== babble-tpu packed-voting smoke (hard gate) =="
 JAX_PLATFORMS=cpu python scripts/packed_smoke.py || rc=1
 
+# Status-dashboard smoke (hard gate, ISSUE 20): a 3-node in-process
+# cluster gossips health digests to convergence, then GET /debug/cluster
+# + /health/digest are served over real TCP and the `babble-tpu status`
+# renderer must show the converged fleet at zero skew with no partition
+# suspicion. A few seconds of wall clock.
+echo "== status smoke (hard gate) =="
+JAX_PLATFORMS=cpu python scripts/status_smoke.py || rc=1
+
 echo "== ruff (advisory) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check babble_tpu/ || echo "ci_lint: ruff reported findings (advisory)"

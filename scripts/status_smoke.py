@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Status-dashboard smoke (ISSUE 20, hard gate in ci_lint.sh / `make
+status-smoke`): boot a 3-node in-process cluster, gossip it to a few
+committed blocks, serve the cluster health plane over a real HTTP
+Service, and assert the `babble-tpu status` renderer shows a converged
+fleet — 3 nodes, zero commit skew, full frontier agreement, no
+partition suspicion.
+
+This is the end-to-end acceptance path for the health plane: digest
+piggyback over live gossip -> fleet federation -> GET /debug/cluster
+over TCP -> the exact dashboard strings an operator reads. A pull of
+GET /health/digest rides along to cover the no-gossip fallback.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from babble_tpu.cli import render_status  # noqa: E402
+from babble_tpu.crypto import generate_key, pub_key_bytes  # noqa: E402
+from babble_tpu.hashgraph import InmemStore  # noqa: E402
+from babble_tpu.net import InmemTransport  # noqa: E402
+from babble_tpu.node import Config, Node  # noqa: E402
+from babble_tpu.peers import Peer, Peers  # noqa: E402
+from babble_tpu.proxy import InmemDummyClient  # noqa: E402
+from babble_tpu.service import Service  # noqa: E402
+
+N = 3
+TARGET_BLOCK = 2
+BUDGET_S = 60.0
+
+
+def fail(msg: str) -> None:
+    print(f"status_smoke: FAIL — {msg}")
+    sys.exit(1)
+
+
+def boot():
+    conf = Config(
+        heartbeat_timeout=0.005, tcp_timeout=1.0, cache_size=1000,
+        sync_limit=300, cluster_staleness_deadline=2.0,
+    )
+    keys = [generate_key() for _ in range(N)]
+    participants = Peers()
+    peer_of_key = []
+    for i, key in enumerate(keys):
+        pub_hex = "0x" + pub_key_bytes(key).hex().upper()
+        peer = Peer(net_addr=f"127.0.0.1:{9950 + i}", pub_key_hex=pub_hex)
+        participants.add_peer(peer)
+        peer_of_key.append(peer)
+    nodes, transports, proxies = [], [], []
+    for i, key in enumerate(keys):
+        trans = InmemTransport(peer_of_key[i].net_addr)
+        prox = InmemDummyClient()
+        node = Node(
+            conf, peer_of_key[i].id, key, participants,
+            InmemStore(participants, conf.cache_size), trans, prox,
+        )
+        node.init()
+        nodes.append(node)
+        transports.append(trans)
+        proxies.append(prox)
+    for t in transports:
+        for u in transports:
+            if t is not u:
+                t.connect(u.local_addr(), u)
+    return nodes, proxies
+
+
+def main() -> int:
+    nodes, proxies = boot()
+    svc = Service("127.0.0.1:0", nodes[0])
+    try:
+        for node in nodes:
+            node.run_async(True)
+        svc.serve()
+        addr = svc.local_addr()
+
+        # drive a few blocks through, then wait for full convergence:
+        # every node at the same frontier AND node 0's fleet table
+        # showing all three digests at zero skew
+        deadline = time.monotonic() + BUDGET_S
+        tx = 0
+        doc = None
+        while time.monotonic() < deadline:
+            for i in range(N):
+                if len(nodes[i].core.transaction_pool) < 50:
+                    proxies[i].submit_tx(f"smoke tx {tx} via {i}".encode())
+                    tx += 1
+            blocks = [n.core.get_last_block_index() for n in nodes]
+            if min(blocks) >= TARGET_BLOCK and len(set(blocks)) == 1:
+                with urllib.request.urlopen(
+                    f"http://{addr}/debug/cluster", timeout=5.0
+                ) as resp:
+                    doc = json.loads(resp.read().decode())
+                d = doc["derived"]
+                if (
+                    len(doc["fleet"]) == N
+                    and d["babble_cluster_commit_skew_blocks"] == 0.0
+                    and d["babble_cluster_frontier_agreement"] == 1.0
+                    and not doc["suspicion"]["suspected"]
+                ):
+                    break
+                doc = None
+            time.sleep(0.01)
+        if doc is None:
+            fail(
+                f"cluster did not converge to {N} nodes at zero skew "
+                f"within {BUDGET_S:.0f}s "
+                f"(blocks={[n.core.get_last_block_index() for n in nodes]})"
+            )
+
+        # the renderer itself is part of the gate: assert the exact
+        # operator-facing strings, not just the JSON
+        out = render_status(doc)
+        print(out)
+        if f"{len(doc['fleet'])} nodes" not in out:
+            fail("renderer did not show the fleet size")
+        if "commit skew: 0 blocks" not in out:
+            fail("renderer did not show zero commit skew")
+        if "frontier agreement: 1" not in out:
+            fail("renderer did not show full frontier agreement")
+        if "partition: none suspected" not in out:
+            fail("renderer shows partition suspicion on a healthy cluster")
+
+        # pull fallback: GET /health/digest serves the node's own digest
+        with urllib.request.urlopen(
+            f"http://{addr}/health/digest", timeout=5.0
+        ) as resp:
+            digest = json.loads(resp.read().decode())
+        if digest.get("addr") != nodes[0].local_addr:
+            fail(f"/health/digest addr mismatch: {digest.get('addr')!r}")
+        if not isinstance(digest.get("block"), int) or digest["block"] < TARGET_BLOCK:
+            fail(f"/health/digest block not converged: {digest.get('block')!r}")
+
+        print(
+            f"status_smoke: PASS — {N} nodes converged at block "
+            f"{digest['block']}, zero skew, dashboard + /health/digest "
+            f"served over {addr}"
+        )
+        return 0
+    finally:
+        svc.shutdown()
+        for node in nodes:
+            node.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
